@@ -1,0 +1,70 @@
+"""gossipy_tpu — a TPU-native gossip-learning / decentralized-FL framework.
+
+A ground-up JAX/XLA re-design of the capabilities of makgyver/gossipy
+(reference mounted at /root/reference). Instead of N Python node objects
+exchanging deep-copied models through a global cache
+(reference: gossipy/__init__.py:283-387, gossipy/simul.py:366-458), the whole
+simulated network lives in ONE stacked pytree with a leading ``node`` axis,
+sharded over a ``jax.sharding.Mesh``; a simulation round is a single jitted
+program and peer-to-peer model exchange compiles to gathers/collectives over
+TPU ICI.
+
+Layout (mirrors the reference's layer map, see SURVEY.md §1; modules marked
+[planned] land later in the build):
+
+- :mod:`gossipy_tpu.core`        — enums, topologies, delay models, mixing matrices
+- :mod:`gossipy_tpu.models`      — flax model definitions (MLP, LogReg, CNN, AdaLine, ...)
+- :mod:`gossipy_tpu.handlers`    — pure-function train/merge/eval model handlers
+- :mod:`gossipy_tpu.data`        — dataset loading, non-IID assignment, dispatching [planned]
+- :mod:`gossipy_tpu.simulation`  — the round engine (vanilla / tokenized / all2all) [planned]
+- :mod:`gossipy_tpu.flow_control`— token-account flow control (Danner 2018)
+- :mod:`gossipy_tpu.parallel`    — mesh construction and node-axis sharding [planned]
+- :mod:`gossipy_tpu.utils`       — pure-JAX metrics, plotting, misc
+"""
+
+from __future__ import annotations
+
+import random as _py_random
+
+import jax
+import numpy as np
+
+__version__ = "0.1.0"
+
+
+def set_seed(seed: int = 42) -> jax.Array:
+    """Seed host-side RNGs and return a root JAX PRNG key.
+
+    The reference seeds ``random``/``numpy``/``torch`` globally
+    (gossipy/__init__.py:118-131). Here device-side randomness is purely
+    functional (``jax.random``), so this seeds the host RNGs used by data
+    assignment/topology generation and returns the root key from which the
+    simulation derives all per-(round, purpose, node) keys via ``fold_in``.
+    """
+    _py_random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+class GlobalSettings:
+    """Minimal stand-in for the reference's device singleton.
+
+    The reference's ``GlobalSettings`` (gossipy/__init__.py:46-91) holds the
+    torch device. In JAX, placement is controlled by shardings/jit, so this
+    class only records a preferred platform string for documentation and a
+    default mesh (see :mod:`gossipy_tpu.parallel`).
+    """
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._platform = None
+        return cls._instance
+
+    def set_device(self, platform: str | None = None) -> None:
+        self._platform = platform
+
+    def get_device(self) -> str:
+        return self._platform or jax.default_backend()

@@ -1,0 +1,98 @@
+"""Model partitioning and coordinate-sampling compression.
+
+TPU-native re-design of ``gossipy/model/sampling.py``:
+
+- ``TorchModelPartition`` (reference sampling.py:110-198) builds per-layer
+  index tuples; here a partition is a *pytree of int32 part-ids*, one per
+  parameter coordinate, built once on host. Partition merge becomes
+  ``where(part_ids == pid, weighted_avg, keep)`` — branch-free, vmappable.
+- ``TorchModelSampling`` (reference sampling.py:37-107) draws ~size*|θ|
+  random coordinates with replacement; here a sample is a Bernoulli(size)
+  mask drawn from a PRNG key at merge time (same expected coverage, no
+  host-side index bookkeeping). Sampled merge = ``where(mask, (p1+p2)/2, p1)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ModelPartition:
+    """Deterministic equal-size partition of all parameters into ``n_parts``.
+
+    Coordinates are split contiguously in flat traversal order (first layer
+    to last), sizes differing by at most 1 — the same contract as the
+    reference's partitioner (sampling.py:110-198, "divides the parameters
+    ... in n_parts parts of equal size starting from the first layer").
+    ``part_ids`` is a pytree matching the params template with an int32 part
+    id per coordinate.
+    """
+
+    def __init__(self, params_template, n_parts: int):
+        leaves, treedef = jax.tree_util.tree_flatten(params_template)
+        total = sum(l.size for l in leaves)
+        self.n_parts = int(min(n_parts, total))
+        # Flat coordinate c belongs to part floor(c * n_parts / total) —
+        # contiguous blocks whose sizes differ by at most one.
+        ids = []
+        offset = 0
+        for leaf in leaves:
+            flat = (np.arange(offset, offset + leaf.size, dtype=np.int64)
+                    * self.n_parts) // total
+            ids.append(jnp.asarray(flat.reshape(leaf.shape), dtype=jnp.int32))
+            offset += leaf.size
+        self.part_ids = jax.tree_util.tree_unflatten(treedef, ids)
+        self.sizes = np.bincount(
+            np.concatenate([np.asarray(i).ravel() for i in ids]),
+            minlength=self.n_parts)
+
+    def merge(self, params1, params2, id_part: jax.Array,
+              weights: tuple[jax.Array, jax.Array] | None = None):
+        """Weighted average of one partition of two models.
+
+        Mirrors ``TorchModelPartition.merge`` (sampling.py:201-234): weights
+        (usually the two ages) are normalized; (0, 0) falls back to (1, 1).
+        ``id_part`` may be traced (it arrives in a message payload).
+        """
+        if weights is None:
+            w1 = w2 = jnp.float32(0.5)
+        else:
+            a1 = jnp.asarray(weights[0], dtype=jnp.float32)
+            a2 = jnp.asarray(weights[1], dtype=jnp.float32)
+            tot = a1 + a2
+            w1 = jnp.where(tot > 0, a1 / jnp.where(tot > 0, tot, 1.0), 0.5)
+            w2 = jnp.where(tot > 0, a2 / jnp.where(tot > 0, tot, 1.0), 0.5)
+        pid = jnp.asarray(id_part, dtype=jnp.int32) % self.n_parts
+
+        def leaf_merge(p1, p2, ids):
+            avg = w1 * p1 + w2 * p2
+            return jnp.where(ids == pid, avg, p1)
+
+        return jax.tree.map(leaf_merge, params1, params2, self.part_ids)
+
+
+def sample_mask(key: jax.Array, params_template, sample_size: float):
+    """Bernoulli(sample_size) coordinate mask pytree.
+
+    Replaces ``TorchModelSampling.sample`` (sampling.py:37-72): the reference
+    draws ~size*|θ| coordinates with replacement (layer chosen ∝ numel);
+    an independent Bernoulli per coordinate has the same expected fraction
+    and is purely functional.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params_template)
+    keys = jax.random.split(key, len(leaves))
+    masks = [jax.random.bernoulli(k, p=sample_size, shape=l.shape)
+             for k, l in zip(keys, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
+def sampled_merge(params1, params2, mask):
+    """In the sampled coordinates, average; elsewhere keep ``params1``.
+
+    Mirrors ``TorchModelSampling.merge`` (sampling.py:75-107).
+    """
+    return jax.tree.map(
+        lambda p1, p2, m: jnp.where(m, (p1 + p2) / 2.0, p1),
+        params1, params2, mask)

@@ -1,0 +1,275 @@
+"""Core protocol primitives: enums, topologies, delay models, mixing matrices.
+
+TPU-native re-design of the reference's ``gossipy/core.py``:
+
+- Enums stay plain Python (they are static, trace-time configuration).
+- ``P2PNetwork``'s dict-of-peer-lists (reference core.py:311-389) becomes a
+  dense boolean adjacency matrix + degree vector — peer sampling for ALL nodes
+  is one vectorized categorical draw.
+- ``Delay`` objects (reference core.py:155-307) become pure samplers returning
+  integer delay arrays for a whole batch of messages at once.
+- ``MixingMatrix`` (reference core.py:392-453) becomes a dense [N, N] weight
+  matrix so the all-to-all merge is a single einsum on the MXU.
+
+Known reference quirk intentionally FIXED here: ``P2PNetwork.size(node)`` uses
+``if node:`` so node 0 reports the global size instead of its degree
+(reference core.py:346-349). Our ``degrees`` vector is correct for all nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import IntEnum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CreateModelMode(IntEnum):
+    """Merge discipline on message receipt (reference core.py:31-44)."""
+
+    UPDATE = 1        # train the received model on local data, adopt it
+    MERGE_UPDATE = 2  # average local+received, then train
+    UPDATE_MERGE = 3  # train both, then average
+    PASS = 4          # adopt the received model as-is
+
+
+class AntiEntropyProtocol(IntEnum):
+    """Gossip exchange protocol (reference core.py:47-58)."""
+
+    PUSH = 1
+    PULL = 2
+    PUSH_PULL = 3
+
+
+class MessageType(IntEnum):
+    """Wire message type (reference core.py:61-75)."""
+
+    PUSH = 1
+    PULL = 2
+    REPLY = 3
+    PUSH_PULL = 4
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+class Topology:
+    """A static P2P topology as a dense adjacency matrix.
+
+    Replaces ``StaticP2PNetwork`` (reference core.py:364-389). The adjacency
+    is a host-side numpy bool [N, N] (built once) plus device copies used
+    inside jitted code. ``sample_peers`` draws one uniform-random neighbor for
+    every node simultaneously — the vectorized equivalent of N calls to
+    ``GossipNode.get_peer()`` (reference node.py:96-109).
+    """
+
+    def __init__(self, adjacency: np.ndarray):
+        adjacency = np.asarray(adjacency)
+        assert adjacency.ndim == 2 and adjacency.shape[0] == adjacency.shape[1], \
+            "adjacency must be a square matrix"
+        adj = adjacency.astype(bool)
+        np.fill_diagonal(adj, False)
+        self.num_nodes: int = adj.shape[0]
+        self.adjacency: np.ndarray = adj
+        self.degrees: np.ndarray = adj.sum(axis=1).astype(np.int32)
+        # Device-side copies (small: N^2 bools).
+        self.adjacency_dev = jnp.asarray(adj)
+        self.degrees_dev = jnp.asarray(self.degrees)
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def clique(n: int) -> "Topology":
+        """Fully-connected topology (reference ``topology=None`` case, core.py:342)."""
+        a = np.ones((n, n), dtype=bool)
+        return Topology(a)
+
+    @staticmethod
+    def ring(n: int, k: int = 1) -> "Topology":
+        """Ring lattice where each node links to its k nearest neighbors per side."""
+        a = np.zeros((n, n), dtype=bool)
+        idx = np.arange(n)
+        for d in range(1, k + 1):
+            a[idx, (idx + d) % n] = True
+            a[idx, (idx - d) % n] = True
+        return Topology(a)
+
+    @staticmethod
+    def random_regular(n: int, degree: int, seed: int = 42) -> "Topology":
+        """k-regular random graph (used by reference main_hegedus_2021.py:44)."""
+        import networkx as nx
+        g = nx.random_regular_graph(degree, n, seed=seed)
+        return Topology(nx.to_numpy_array(g))
+
+    @staticmethod
+    def barabasi_albert(n: int, m: int, seed: int = 42) -> "Topology":
+        """Preferential-attachment graph (reference main_giaretta_2019.py)."""
+        import networkx as nx
+        g = nx.barabasi_albert_graph(n, m, seed=seed)
+        return Topology(nx.to_numpy_array(g))
+
+    @staticmethod
+    def erdos_renyi(n: int, p: float, seed: int = 42) -> "Topology":
+        import networkx as nx
+        g = nx.erdos_renyi_graph(n, p, seed=seed)
+        return Topology(nx.to_numpy_array(g))
+
+    # -- queries ------------------------------------------------------------
+
+    def get_peers(self, node_id: int) -> list[int]:
+        """Peer id list of one node (API parity with reference core.py:380-389)."""
+        return list(np.where(self.adjacency[node_id])[0])
+
+    def size(self, node: Optional[int] = None) -> int:
+        """Number of nodes, or the degree of ``node`` if given.
+
+        Unlike the reference (core.py:346-349, the ``if node:`` bug), node 0
+        correctly reports its degree.
+        """
+        if node is None:
+            return self.num_nodes
+        return int(self.degrees[node])
+
+    def sample_peers(self, key: jax.Array) -> jax.Array:
+        """Draw one uniform-random neighbor for every node. Returns int32 [N].
+
+        Nodes with zero degree get peer -1 (callers mask those sends).
+        """
+        return sample_peers(key, self.adjacency_dev)
+
+
+def sample_peers(key: jax.Array, adjacency: jax.Array) -> jax.Array:
+    """Uniform neighbor draw for all rows of a boolean adjacency [N, N]."""
+    logits = jnp.where(adjacency, 0.0, -jnp.inf)
+    peers = jax.random.categorical(key, logits, axis=-1)
+    has_peer = adjacency.any(axis=-1)
+    return jnp.where(has_peer, peers, -1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Delay models
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Delay:
+    """Base message-latency model (reference core.py:155-177).
+
+    Delays are sampled for whole message batches: ``sample(key, shape, size)``
+    returns an int32 array of delays in simulation time units, where ``size``
+    is the (static) message size in atomic scalars — the quantity the
+    reference computes per message via ``Sizeable.get_size()``
+    (reference gossipy/__init__.py:134-156, core.py:109-144).
+    """
+
+    def max_delay(self, size: int) -> int:
+        raise NotImplementedError
+
+    def sample(self, key: jax.Array, shape: tuple, size: int) -> jax.Array:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstantDelay(Delay):
+    """Fixed delay (reference core.py:179-216)."""
+
+    delay: int = 0
+
+    def max_delay(self, size: int) -> int:
+        return self.delay
+
+    def sample(self, key, shape, size):
+        return jnp.full(shape, self.delay, dtype=jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformDelay(Delay):
+    """Uniform integer delay in [min_delay, max_delay] (reference core.py:219-259)."""
+
+    min_delay: int
+    max_delay_: int
+
+    def __post_init__(self):
+        assert 0 <= self.min_delay <= self.max_delay_
+
+    def max_delay(self, size: int) -> int:
+        return self.max_delay_
+
+    def sample(self, key, shape, size):
+        return jax.random.randint(key, shape, self.min_delay, self.max_delay_ + 1,
+                                  dtype=jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearDelay(Delay):
+    """Overhead + size-proportional delay (reference core.py:262-307).
+
+    ``delay = floor(timexunit * size) + overhead``; with static model sizes
+    this is deterministic per message class.
+    """
+
+    timexunit: float
+    overhead: int
+
+    def max_delay(self, size: int) -> int:
+        return int(self.timexunit * size) + self.overhead
+
+    def sample(self, key, shape, size):
+        return jnp.full(shape, int(self.timexunit * size) + self.overhead,
+                        dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Mixing matrices (all-to-all decentralized SGD, Koloskova et al. 2020)
+# ---------------------------------------------------------------------------
+
+def uniform_mixing(topology: Topology) -> jnp.ndarray:
+    """Dense [N, N] uniform mixing matrix.
+
+    Row i weights node i and each of its deg(i) peers by 1/(deg(i)+1) —
+    the matrix form of ``UniformMixing.get`` (reference core.py:419-434),
+    which returns the per-node weight vector [self] + peers.
+    """
+    a = topology.adjacency.astype(np.float64)
+    deg = a.sum(axis=1)
+    w = a / (deg[:, None] + 1.0)
+    np.fill_diagonal(w, 1.0 / (deg + 1.0))
+    return jnp.asarray(w, dtype=jnp.float32)
+
+
+def metropolis_hastings_mixing(topology: Topology) -> jnp.ndarray:
+    """Dense [N, N] Metropolis-Hastings mixing matrix (symmetric, doubly stochastic).
+
+    W_ij = 1 / (1 + max(deg_i, deg_j)) for edges, W_ii = 1 - sum_j W_ij.
+    The reference's ``MetropolisHastingsMixing`` (core.py:437-453) computes
+    ``[1/deg_i] + [1/(min(deg_k, deg_i)+1)]`` whose rows do not sum to 1 and
+    which inherits the node-0 degree bug; we implement the standard
+    (convergent) MH weights instead — an intentional, documented divergence.
+    """
+    a = topology.adjacency.astype(np.float64)
+    deg = a.sum(axis=1)
+    denom = 1.0 + np.maximum(deg[:, None], deg[None, :])
+    w = a / denom
+    np.fill_diagonal(w, 0.0)
+    np.fill_diagonal(w, 1.0 - w.sum(axis=1))
+    return jnp.asarray(w, dtype=jnp.float32)
+
+
+def mixing_weight_rows(w: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+    """Per-node weight vectors in reference layout ([self_weight, peer weights...]).
+
+    Provided for API parity with ``MixingMatrix.__getitem__``
+    (reference core.py:412-413); padded with zeros to the max degree.
+    """
+    n = topology.num_nodes
+    max_deg = int(topology.degrees.max()) if n else 0
+    out = np.zeros((n, max_deg + 1), dtype=np.float32)
+    w_np = np.asarray(w)
+    for i in range(n):
+        peers = np.where(topology.adjacency[i])[0]
+        out[i, 0] = w_np[i, i]
+        out[i, 1:1 + len(peers)] = w_np[i, peers]
+    return jnp.asarray(out)

@@ -1,0 +1,118 @@
+"""Token-account flow control (Danner 2018), vectorized over the node axis.
+
+Re-design of ``gossipy/flow_control.py``. The reference keeps one mutable
+``TokenAccount`` object per node; here an account *type* is a static policy
+whose ``proactive``/``reactive`` functions map a whole int32 balance vector
+[N] to probabilities / reaction counts — so the tokenized simulator evaluates
+flow control for every node in one fused op.
+
+Balances themselves live in the simulator's stacked node state; ``add``/
+``sub`` (reference flow_control.py:32-52, floored at 0) are plain array ops
+applied by the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenAccount:
+    """Base policy. ``proactive(balance) -> float[N]`` gives each node's
+    probability of sending at its timeout; ``reactive(balance, utility, key)
+    -> int32[N]`` gives the number of immediate reaction sends triggered by a
+    received message of the given utility (reference flow_control.py:54-82).
+    """
+
+    def init_balance(self, n_nodes: int) -> jax.Array:
+        return jnp.zeros((n_nodes,), dtype=jnp.int32)
+
+    def proactive(self, balance: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def reactive(self, balance: jax.Array, utility: jax.Array,
+                 key: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class PurelyProactiveTokenAccount(TokenAccount):
+    """Always send, never react — vanilla push gossip (flow_control.py:85-102)."""
+
+    def proactive(self, balance):
+        return jnp.ones_like(balance, dtype=jnp.float32)
+
+    def reactive(self, balance, utility, key):
+        return jnp.zeros_like(balance)
+
+
+@dataclasses.dataclass(frozen=True)
+class PurelyReactiveTokenAccount(TokenAccount):
+    """Never proactive; react with ``utility * k`` sends (flow_control.py:105-127)."""
+
+    k: int = 1
+
+    def proactive(self, balance):
+        return jnp.zeros_like(balance, dtype=jnp.float32)
+
+    def reactive(self, balance, utility, key):
+        return (utility * self.k).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleTokenAccount(TokenAccount):
+    """Proactive iff balance >= capacity; reactive iff balance > 0
+    (flow_control.py:130-154)."""
+
+    C: int = 1
+
+    def __post_init__(self):
+        assert self.C >= 1, "The capacity C must be strictly positive."
+
+    def proactive(self, balance):
+        return (balance >= self.C).astype(jnp.float32)
+
+    def reactive(self, balance, utility, key):
+        return (balance > 0).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedTokenAccount(SimpleTokenAccount):
+    """Danner 2018 generalized reactive rule (flow_control.py:157-189):
+
+    reactive(a, u) = floor((A-1+a)/A) if u > 0 else floor((A-1+a)/(2A)).
+    """
+
+    A: int = 1
+
+    def __post_init__(self):
+        assert self.C >= 1, "The capacity C must be positive."
+        assert self.A >= 1, "The reactivity A must be positive."
+        assert self.A <= self.C, \
+            "The capacity C must be greater or equal than the reactivity A."
+
+    def reactive(self, balance, utility, key):
+        num = self.A - 1 + balance
+        useful = utility > 0
+        return jnp.where(useful, num // self.A, num // (2 * self.A)).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomizedTokenAccount(GeneralizedTokenAccount):
+    """Linear proactive ramp on [A-1, C]; randomized-rounding reactive
+    (flow_control.py:192-236)."""
+
+    def proactive(self, balance):
+        b = balance.astype(jnp.float32)
+        ramp = (b - self.A + 1) / float(self.C - self.A + 1)
+        return jnp.clip(jnp.where(b < self.A - 1, 0.0, ramp), 0.0, 1.0)
+
+    def reactive(self, balance, utility, key):
+        r = balance.astype(jnp.float32) / self.A
+        frac = r - jnp.floor(r)
+        rand_round = jnp.floor(r).astype(jnp.int32) + \
+            jax.random.bernoulli(key, jnp.clip(frac, 0.0, 1.0)).astype(jnp.int32)
+        return jnp.where(utility > 0, rand_round, 0)

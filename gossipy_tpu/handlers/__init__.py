@@ -1,0 +1,21 @@
+"""Model handlers: pure train/merge/eval engines (reference gossipy/model/handler.py)."""
+
+from .base import BaseHandler, ModelState, PeerModel, select_state
+from .linear import AdaLineHandler, PegasosHandler
+from .kmeans import KMeansHandler
+from .mf import MFHandler
+from .sgd import (
+    LimitedMergeSGDHandler,
+    PartitionedSGDHandler,
+    SamplingSGDHandler,
+    SGDHandler,
+    WeightedSGDHandler,
+)
+from . import losses
+
+__all__ = [
+    "BaseHandler", "ModelState", "PeerModel", "select_state",
+    "AdaLineHandler", "PegasosHandler", "KMeansHandler", "MFHandler",
+    "SGDHandler", "WeightedSGDHandler", "LimitedMergeSGDHandler",
+    "SamplingSGDHandler", "PartitionedSGDHandler", "losses",
+]

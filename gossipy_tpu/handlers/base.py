@@ -1,0 +1,109 @@
+"""Handler protocol: pure train/merge/eval functions over pytree model states.
+
+The reference's ``ModelHandler`` (gossipy/model/handler.py:58-182) is a
+stateful object that deep-copies itself into a global cache on every send.
+Here a handler is a *static configuration object* whose methods are pure
+functions over :class:`ModelState`; the simulation engine vmaps them across
+the node axis and closes over the handler when jitting (no mutable state, no
+copies — "sending a model" is a gather along the node axis).
+
+``CreateModelMode`` dispatch (reference handler.py:117-136) happens at trace
+time (the mode is static), so each compiled program contains exactly one
+branch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+
+from ..core import CreateModelMode
+
+
+class ModelState(NamedTuple):
+    """One node's full learning state (stacks along a leading node axis).
+
+    - ``params``: model parameter pytree
+    - ``opt_state``: optimizer state pytree (``()`` for stateless rules)
+    - ``n_updates``: int32 age — scalar, or [n_parts] for partitioned handlers
+      (reference handler.py:92, PartitionedTMH at :475)
+    """
+
+    params: Any
+    opt_state: Any
+    n_updates: jax.Array
+
+
+class PeerModel(NamedTuple):
+    """What travels in a message: the sender's params + age snapshot.
+
+    The reference ships the whole deep-copied handler through ``CACHE``
+    (handler.py:160-176); optimizer state is omitted here — for the plain-SGD
+    experiments it is empty anyway, and receivers train received models with
+    their own optimizer slot.
+    """
+
+    params: Any
+    n_updates: jax.Array
+
+
+class BaseHandler:
+    """Common mode-dispatch logic. Subclasses define init/update/merge/evaluate.
+
+    Method contracts (single node; the engine vmaps):
+
+    - ``init(key) -> ModelState``
+    - ``update(state, data, key) -> ModelState`` — local training pass
+    - ``merge(state, peer, extra=None) -> ModelState``
+    - ``evaluate(state, data) -> dict[str, Array]``
+    - ``call(state, peer, data, key, extra=None) -> ModelState`` — the
+      receive-time composition (reference handler.py:117-136)
+    """
+
+    mode: CreateModelMode = CreateModelMode.MERGE_UPDATE
+
+    # -- abstract ----------------------------------------------------------
+    def init(self, key: jax.Array) -> ModelState:
+        raise NotImplementedError
+
+    def update(self, state: ModelState, data, key: jax.Array) -> ModelState:
+        raise NotImplementedError
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        raise NotImplementedError
+
+    def evaluate(self, state: ModelState, data) -> dict:
+        raise NotImplementedError
+
+    # -- shared ------------------------------------------------------------
+    def peer_view(self, state: ModelState) -> PeerModel:
+        """The message payload for this node's state."""
+        return PeerModel(state.params, state.n_updates)
+
+    def call(self, state: ModelState, peer: PeerModel, data, key: jax.Array,
+             extra=None) -> ModelState:
+        """Receive-time dispatch on the (static) create-model mode."""
+        mode = self.mode
+        if mode == CreateModelMode.UPDATE:
+            # Train the received model on local data, adopt it (handler.py:122-125).
+            recv_state = ModelState(peer.params, state.opt_state, peer.n_updates)
+            return self.update(recv_state, data, key)
+        if mode == CreateModelMode.MERGE_UPDATE:
+            merged = self.merge(state, peer, extra)
+            return self.update(merged, data, key)
+        if mode == CreateModelMode.UPDATE_MERGE:
+            k1, k2 = jax.random.split(key)
+            mine = self.update(state, data, k1)
+            recv_state = ModelState(peer.params, state.opt_state, peer.n_updates)
+            theirs = self.update(recv_state, data, k2)
+            return self.merge(mine, PeerModel(theirs.params, theirs.n_updates), extra)
+        if mode == CreateModelMode.PASS:
+            return ModelState(peer.params, state.opt_state, peer.n_updates)
+        raise ValueError(f"Unknown create model mode {mode}")
+
+
+def select_state(cond: jax.Array, a: ModelState, b: ModelState) -> ModelState:
+    """``cond ? a : b`` leafwise — used to mask no-op receives in the engine."""
+    import jax.numpy as jnp
+    return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)

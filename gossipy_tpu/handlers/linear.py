@@ -1,0 +1,94 @@
+"""AdaLine and Pegasos handlers — manual (autograd-free) linear learners.
+
+Re-design of reference handler.py:337-423. The reference loops over samples
+in Python (handler.py:367-368, :418-423); here the per-sample recurrences are
+``lax.scan``s, so one node's whole local pass is a single fused kernel and
+all N nodes run under one vmap.
+
+Labels are ±1 (Ormandi 2013 experiments); evaluation mirrors
+``AdaLineHandler.evaluate`` (handler.py:375-391).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import CreateModelMode
+from ..models.nn import AdaLine
+from ..utils import signed_binary_metrics
+from .base import BaseHandler, ModelState, PeerModel
+
+
+class AdaLineHandler(BaseHandler):
+    """Delta-rule learner (reference handler.py:337-391).
+
+    Per sample: ``w += lr * (y_i - w.x_i) * x_i``; ``n_updates`` counts
+    samples seen (handler.py:366).
+    """
+
+    def __init__(self, net: AdaLine, learning_rate: float,
+                 create_model_mode: CreateModelMode = CreateModelMode.UPDATE):
+        self.net = net
+        self.learning_rate = learning_rate
+        self.mode = create_model_mode
+
+    def init(self, key: jax.Array) -> ModelState:
+        return ModelState(self.net.init(), (), jnp.int32(0))
+
+    def _scan_samples(self, w0, n0, X, y, mask, body):
+        def step(carry, inp):
+            w, n = carry
+            x_i, y_i, m_i = inp
+            w_new, n_new = body(w, n, x_i, y_i)
+            w = jnp.where(m_i > 0, w_new, w)
+            n = jnp.where(m_i > 0, n_new, n)
+            return (w, n), None
+
+        (w, n), _ = jax.lax.scan(step, (w0, n0), (X, y, mask))
+        return w, n
+
+    def update(self, state: ModelState, data, key: jax.Array) -> ModelState:
+        X, y, mask = data
+        lr = self.learning_rate
+
+        def body(w, n, x_i, y_i):
+            return w + lr * (y_i - w @ x_i) * x_i, n + 1
+
+        w, n = self._scan_samples(state.params, state.n_updates, X, y, mask, body)
+        return ModelState(w, (), n)
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        w = 0.5 * (state.params + peer.params)  # handler.py:370-373
+        return ModelState(w, (), jnp.maximum(state.n_updates, peer.n_updates))
+
+    def evaluate(self, state: ModelState, data) -> dict:
+        X, y, mask = data
+        return signed_binary_metrics(X @ state.params, y, mask)
+
+
+class PegasosHandler(AdaLineHandler):
+    """Pegasos SVM (reference handler.py:394-423).
+
+    Per sample with running count t: ``eta = 1/(t * lam)``; the margin test
+    uses the score from BEFORE the decay (handler.py:421-423):
+    ``w <- (1 - eta*lam) * w + [y_i * (w_old.x_i) < 1] * eta * y_i * x_i``.
+    ``learning_rate`` is the regularization constant lambda, as in the
+    reference's naming.
+    """
+
+    def update(self, state: ModelState, data, key: jax.Array) -> ModelState:
+        X, y, mask = data
+        lam = self.learning_rate
+
+        def body(w, n, x_i, y_i):
+            t = (n + 1).astype(jnp.float32)
+            eta = 1.0 / (t * lam)
+            score = w @ x_i
+            w = w * (1.0 - eta * lam)
+            hinge_active = (score * y_i - 1.0) < 0
+            w = w + jnp.where(hinge_active, eta * y_i, 0.0) * x_i
+            return w, n + 1
+
+        w, n = self._scan_samples(state.params, state.n_updates, X, y, mask, body)
+        return ModelState(w, (), n)

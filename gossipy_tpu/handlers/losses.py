@@ -1,0 +1,51 @@
+"""Masked loss functions.
+
+The reference passes ``torch.nn`` criteria into ``TorchModelHandler``
+(handler.py:190,225). Here losses are pure ``(scores, targets, mask) ->
+scalar`` functions; ``mask`` weights out shard padding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_mean(v: jax.Array, mask: jax.Array | None) -> jax.Array:
+    if mask is None:
+        return v.mean()
+    m = mask.astype(v.dtype)
+    denom = m.sum()
+    return jnp.where(denom > 0, (v * m).sum() / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def cross_entropy(scores: jax.Array, y: jax.Array,
+                  mask: jax.Array | None = None) -> jax.Array:
+    """``torch.nn.CrossEntropyLoss`` equivalent: log-softmax over scores + NLL.
+
+    Accepts integer labels [B] or one-hot [B, C]. Note the reference applies
+    this on top of sigmoid outputs for LogisticRegression — identical here
+    since the model itself emits the sigmoid (models/nn.py).
+    """
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    if y.ndim == scores.ndim:
+        nll = -(y * logp).sum(axis=-1)
+    else:
+        nll = -jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return _masked_mean(nll, mask)
+
+
+def mse(scores: jax.Array, y: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """``torch.nn.MSELoss`` equivalent."""
+    if y.ndim < scores.ndim:
+        y = y[..., None]
+    err = ((scores - y) ** 2).mean(axis=-1)
+    return _masked_mean(err, mask)
+
+
+def binary_cross_entropy(scores: jax.Array, y: jax.Array,
+                         mask: jax.Array | None = None) -> jax.Array:
+    """``torch.nn.BCELoss`` equivalent on probability outputs (e.g. Perceptron)."""
+    s = jnp.clip(scores.squeeze(-1) if scores.ndim > y.ndim else scores, 1e-7, 1 - 1e-7)
+    nll = -(y * jnp.log(s) + (1 - y) * jnp.log(1 - s))
+    return _masked_mean(nll, mask)

@@ -1,0 +1,102 @@
+"""Matrix-factorization recommender handler (Hegedus 2020 gossip MF).
+
+Re-design of ``MFModelHandler`` (reference handler.py:528-576). Each node is
+one user: params = {user factor X [k], user bias b, item factors Y
+[n_items, k], item biases c [n_items]}. The per-rating SGD loop
+(handler.py:550-560) becomes a ``lax.scan`` over the node's padded rating
+list; only the item state (Y, c) is merged between peers (handler.py:562-568).
+
+Intentional divergence: the reference's merge divides by ``2 * (n1 + n2)``
+(handler.py:566-567), which SHRINKS the merged factors by half on every
+exchange — we use the proper age-weighted average (divide by ``n1 + n2``),
+documented per SURVEY.md §7(f).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import CreateModelMode
+from ..utils import rmse
+from .base import BaseHandler, ModelState, PeerModel
+
+
+class MFHandler(BaseHandler):
+    """Gossip matrix factorization for one-user-per-node recommendation.
+
+    Data convention: ``data = (items, ratings, mask)`` — int32 item ids [S],
+    float ratings [S], validity mask [S].
+    """
+
+    def __init__(self, dim: int, n_items: int, lam_reg: float = 0.1,
+                 learning_rate: float = 0.001,
+                 r_min: float = 1.0, r_max: float = 5.0,
+                 create_model_mode: CreateModelMode = CreateModelMode.UPDATE):
+        self.k = dim
+        self.n_items = n_items
+        self.reg = lam_reg
+        self.lr = learning_rate
+        self.r_min = r_min
+        self.r_max = r_max
+        self.mode = create_model_mode
+
+    def init(self, key: jax.Array) -> ModelState:
+        # handler.py:542-548: U(0,1)*sqrt((r_max-r_min)/k) factors, r_min/2 biases.
+        kx, ky = jax.random.split(key)
+        mul = jnp.sqrt((self.r_max - self.r_min) / self.k)
+        params = {
+            "X": jax.random.uniform(kx, (self.k,)) * mul,
+            "b": jnp.float32(self.r_min / 2.0),
+            "Y": jax.random.uniform(ky, (self.n_items, self.k)) * mul,
+            "c": jnp.ones((self.n_items,)) * (self.r_min / 2.0),
+        }
+        # n_updates starts at 1 (handler.py:540).
+        return ModelState(params, (), jnp.int32(1))
+
+    def update(self, state: ModelState, data, key: jax.Array) -> ModelState:
+        items, ratings, mask = data
+        lr, reg = self.lr, self.reg
+
+        def step(carry, inp):
+            p, n = carry
+            i, r, m = inp
+            yi = p["Y"][i]
+            err = r - p["X"] @ yi - p["b"] - p["c"][i]
+            yi_new = (1.0 - reg * lr) * yi + lr * err * p["X"]
+            x_new = (1.0 - reg * lr) * p["X"] + lr * err * yi_new  # uses updated Y[i], handler.py:555-556
+            p_new = {
+                "X": x_new,
+                "b": p["b"] + lr * err,
+                "Y": p["Y"].at[i].set(yi_new),
+                "c": p["c"].at[i].add(lr * err),
+            }
+            p = jax.tree.map(lambda a, b: jnp.where(m > 0, a, b), p_new, p)
+            return (p, n + (m > 0).astype(n.dtype)), None
+
+        (params, n), _ = jax.lax.scan(
+            step, (state.params, state.n_updates),
+            (items.astype(jnp.int32), ratings, mask))
+        return ModelState(params, (), n)
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        n1 = state.n_updates.astype(jnp.float32)
+        n2 = peer.n_updates.astype(jnp.float32)
+        den = jnp.maximum(n1 + n2, 1.0)
+        params = dict(state.params)
+        params["Y"] = (state.params["Y"] * n1 + peer.params["Y"] * n2) / den
+        params["c"] = (state.params["c"] * n1 + peer.params["c"] * n2) / den
+        # Ages: the reference keeps self.n_updates unchanged on MF merge
+        # (handler.py:562-568 never touches it); mirror that.
+        return ModelState(params, (), state.n_updates)
+
+    def evaluate(self, state: ModelState, data) -> dict:
+        items, ratings, mask = data
+        p = state.params
+        pred_all = p["Y"] @ p["X"] + p["b"] + p["c"]  # [n_items]
+        pred = pred_all[items.astype(jnp.int32)]
+        return {"rmse": rmse(pred, ratings, mask)}
+
+    def get_size(self) -> int:
+        """Message size in scalars (handler.py:575-576): only (Y, c) travel."""
+        return self.k * (self.n_items + 1)

@@ -1,0 +1,273 @@
+"""SGD-trained neural model handlers (the ``TorchModelHandler`` family).
+
+Re-design of reference gossipy/model/handler.py:185-334 and its variants
+(:455-525 partitioned, :426-452 sampled, :642-688 weighted, :690-739
+limited-merge). Training is a ``lax.scan`` over permuted minibatches of the
+node's padded shard; autograd via ``jax.value_and_grad``; optimizers are
+optax gradient transformations. Everything is a pure function of
+``(ModelState, data, key)`` so the engine can vmap it across all nodes.
+
+Data convention: ``data = (X, y, mask)`` with static shard length S; ``mask``
+flags real rows vs padding (SURVEY.md §7 hard part (a)).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..compression import ModelPartition, sample_mask, sampled_merge
+from ..core import CreateModelMode
+from ..utils import classification_metrics
+from .base import BaseHandler, ModelState, PeerModel
+
+
+def _tree_avg(p1, p2):
+    return jax.tree.map(lambda a, b: (a + b) / 2.0, p1, p2)
+
+
+class SGDHandler(BaseHandler):
+    """Train/merge/eval for a flax model under an optax optimizer.
+
+    Equivalent of ``TorchModelHandler`` (reference handler.py:185-334):
+
+    - ``update`` = ``local_epochs`` x permuted minibatch SGD (handler.py:235-248),
+      as a ``lax.scan`` over static-size batches with mask-weighted loss.
+      ``n_updates`` increments once per non-empty batch (handler.py:258).
+    - ``merge`` = uniform parameter average, age = max (handler.py:260-280).
+    - ``evaluate`` = accuracy/precision/recall/F1 (+AUC for binary)
+      (handler.py:282-334) in pure JAX.
+    """
+
+    def __init__(self,
+                 model,
+                 loss: Callable,
+                 optimizer: optax.GradientTransformation | None = None,
+                 learning_rate: float = 0.01,
+                 local_epochs: int = 1,
+                 batch_size: int = 32,
+                 n_classes: int = 2,
+                 input_shape: Sequence[int] = (2,),
+                 create_model_mode: CreateModelMode = CreateModelMode.MERGE_UPDATE):
+        assert (batch_size == 0 and local_epochs > 0) or batch_size > 0, \
+            "batch_size == 0 (full batch) requires local_epochs > 0"  # handler.py:226
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer if optimizer is not None else optax.sgd(learning_rate)
+        self.local_epochs = local_epochs
+        self.batch_size = batch_size
+        self.n_classes = n_classes
+        self.input_shape = tuple(input_shape)
+        self.mode = create_model_mode
+
+    # -- model plumbing ----------------------------------------------------
+
+    def apply(self, params, x):
+        return self.model.apply({"params": params}, x)
+
+    def init(self, key: jax.Array) -> ModelState:
+        dummy = jnp.zeros((1,) + self.input_shape, dtype=jnp.float32)
+        params = self.model.init(key, dummy)["params"]
+        opt_state = self.optimizer.init(params)
+        return ModelState(params, opt_state, jnp.int32(0))
+
+    # -- training ----------------------------------------------------------
+
+    def _adjust_gradient(self, grads, n_updates):
+        """Hook for subclasses (PartitionedSGDHandler divides by partition age)."""
+        return grads
+
+    def _count_updates(self, n_updates, any_real):
+        return n_updates + any_real.astype(n_updates.dtype)
+
+    def _sgd_step(self, state: ModelState, xb, yb, mb) -> ModelState:
+        params, opt_state, n_updates = state
+
+        def loss_fn(p):
+            return self.loss(self.apply(p, xb), yb, mb)
+
+        grads = jax.grad(loss_fn)(params)
+        any_real = mb.sum() > 0
+        # PartitionedTMH increments ages BEFORE the gradient adjustment
+        # (handler.py:503-512); for the plain handler the increment is
+        # equivalent to the post-step one at handler.py:258.
+        n_new = self._count_updates(n_updates, any_real)
+        grads = self._adjust_gradient(grads, n_new)
+        updates, opt_new = self.optimizer.update(grads, opt_state, params)
+        p_new = optax.apply_updates(params, updates)
+        # Empty (fully padded) batches are no-ops.
+        params = jax.tree.map(lambda a, b: jnp.where(any_real, a, b), p_new, params)
+        opt_state = jax.tree.map(lambda a, b: jnp.where(any_real, a, b), opt_new, opt_state)
+        return ModelState(params, opt_state, n_new)
+
+    def update(self, state: ModelState, data, key: jax.Array) -> ModelState:
+        X, y, mask = data
+        S = X.shape[0]
+        B = self.batch_size if self.batch_size else S
+        n_batches = max(1, math.ceil(S / B))
+        pad = n_batches * B - S
+
+        def run_epoch(state, ekey):
+            perm = jax.random.permutation(ekey, S)
+            if pad:
+                # Wrap indices so the padded tail is valid even when B >> S;
+                # slot_ok masks every slot past the real shard length.
+                perm = perm[jnp.arange(n_batches * B) % S]
+            slot_ok = (jnp.arange(n_batches * B) < S).astype(mask.dtype)
+
+            def step(st, i):
+                idx = jax.lax.dynamic_slice(perm, (i * B,), (B,))
+                mb = mask[idx] * jax.lax.dynamic_slice(slot_ok, (i * B,), (B,))
+                return self._sgd_step(st, X[idx], y[idx], mb), None
+
+            state, _ = jax.lax.scan(step, state, jnp.arange(n_batches))
+            return state, None
+
+        if self.local_epochs > 0:
+            keys = jax.random.split(key, self.local_epochs)
+            state, _ = jax.lax.scan(run_epoch, state, keys)
+            return state
+        # local_epochs == 0: one step on batch_size random samples (handler.py:245-247)
+        perm = jax.random.permutation(key, S)[:B]
+        return self._sgd_step(state, X[perm], y[perm], mask[perm])
+
+    # -- merging -----------------------------------------------------------
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        params = _tree_avg(state.params, peer.params)
+        return ModelState(params, state.opt_state,
+                          jnp.maximum(state.n_updates, peer.n_updates))
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, state: ModelState, data) -> dict:
+        X, y, mask = data
+        scores = self.apply(state.params, X)
+        return classification_metrics(scores, y, self.n_classes, mask)
+
+
+class WeightedSGDHandler(SGDHandler):
+    """Merge with caller-supplied weights over 1 + K models (``WeightedTMH``).
+
+    Reference handler.py:642-688: ``merged = w0 * self + sum_k w_k * other_k``.
+    ``extra`` = (stacked peer params with leading K axis, weights [K+1],
+    peer ages [K], valid mask [K]).
+    """
+
+    def merge_many(self, state: ModelState, peers_params, weights,
+                   peer_ages, valid) -> ModelState:
+        w0 = weights[0]
+        wk = weights[1:] * valid  # zero out empty slots
+        # Renormalize so the dropped slots' mass goes back to a proper average.
+        total = w0 + wk.sum()
+        w0 = w0 / total
+        wk = wk / total
+
+        def leaf(p_self, p_peers):
+            wk_b = wk.reshape((-1,) + (1,) * p_self.ndim)
+            return w0 * p_self + (wk_b * p_peers).sum(axis=0)
+
+        params = jax.tree.map(lambda a, b: leaf(a, b), state.params, peers_params)
+        ages = jnp.where(valid > 0, peer_ages, 0)
+        n_up = jnp.maximum(state.n_updates, ages.max(initial=0))
+        return ModelState(params, state.opt_state, n_up)
+
+
+class LimitedMergeSGDHandler(SGDHandler):
+    """Danner 2023 limited merging (``LimitedMergeTMH``, handler.py:690-739).
+
+    If the age gap exceeds L, adopt the younger... actually the OLDER model
+    wholesale (the one with more updates wins); otherwise age-weighted average.
+    """
+
+    def __init__(self, *args, age_diff_threshold: int = 1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.L = age_diff_threshold
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        a1 = state.n_updates.astype(jnp.float32)
+        a2 = peer.n_updates.astype(jnp.float32)
+        tot = a1 + a2
+        # Two age-0 models fall back to a plain average (cf. the identical
+        # guard in ModelPartition.merge); without this the weighted branch
+        # would zero both models out.
+        w1 = jnp.where(tot > 0, a1 / jnp.where(tot > 0, tot, 1.0), 0.5)
+        w2 = jnp.where(tot > 0, a2 / jnp.where(tot > 0, tot, 1.0), 0.5)
+        keep_self = a1 > a2 + self.L
+        keep_peer = a2 > a1 + self.L
+
+        def leaf(p1, p2):
+            avg = w1 * p1 + w2 * p2
+            return jnp.where(keep_self, p1, jnp.where(keep_peer, p2, avg))
+
+        params = jax.tree.map(leaf, state.params, peer.params)
+        return ModelState(params, state.opt_state,
+                          jnp.maximum(state.n_updates, peer.n_updates))
+
+
+class SamplingSGDHandler(SGDHandler):
+    """Merge only a random coordinate subset (``SamplingTMH``, handler.py:426-452).
+
+    ``extra`` is a PRNG key identifying the sample; both sides of an exchange
+    derive the same mask from it (the reference ships explicit index sets in
+    the message — a key is the 2-word equivalent).
+    """
+
+    def __init__(self, sample_size: float, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert self.mode != CreateModelMode.PASS, \
+            "Mode PASS not allowed for sampled models."  # handler.py:449-450
+        self.sample_size = sample_size
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        assert extra is not None, "SamplingSGDHandler.merge needs a sample key"
+        mask = sample_mask(extra, state.params, self.sample_size)
+        params = sampled_merge(state.params, peer.params, mask)
+        # Reference SamplingTMH._merge does not advance n_updates (handler.py:431-433).
+        return ModelState(params, state.opt_state, state.n_updates)
+
+
+class PartitionedSGDHandler(SGDHandler):
+    """Partitioned model exchange (``PartitionedTMH``, handler.py:455-525).
+
+    - ``n_updates`` is an int32 [n_parts] age vector (handler.py:475).
+    - ``merge`` averages one partition, age-weighted (handler.py:497-501).
+    - Gradients are divided by the partition's age before the step
+      (handler.py:514-520).
+    ``extra`` = the (traced) partition id from the message payload.
+    """
+
+    def __init__(self, partition: ModelPartition, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert self.mode != CreateModelMode.PASS, \
+            "Mode PASS not allowed for partitioned models."  # handler.py:491-492
+        self.partition = partition
+
+    def init(self, key: jax.Array) -> ModelState:
+        st = super().init(key)
+        return ModelState(st.params, st.opt_state,
+                          jnp.zeros((self.partition.n_parts,), dtype=jnp.int32))
+
+    def _count_updates(self, n_updates, any_real):
+        return n_updates + any_real.astype(n_updates.dtype)  # all parts +1 (handler.py:506)
+
+    def _adjust_gradient(self, grads, n_updates):
+        ages = jnp.maximum(n_updates.astype(jnp.float32), 1.0)
+
+        def leaf(g, pid):
+            return g / ages[pid]
+
+        return jax.tree.map(leaf, grads, self.partition.part_ids)
+
+    def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
+        assert extra is not None, "PartitionedSGDHandler.merge needs a partition id"
+        pid = jnp.asarray(extra) % self.partition.n_parts
+        a1 = state.n_updates[pid]
+        a2 = peer.n_updates[pid]
+        params = self.partition.merge(state.params, peer.params, pid, weights=(a1, a2))
+        n_up = state.n_updates.at[pid].set(jnp.maximum(a1, a2))
+        return ModelState(params, state.opt_state, n_up)

@@ -1,0 +1,24 @@
+"""Model zoo: flax.linen re-designs of the reference's model classes.
+
+Reference models live in ``gossipy/model/nn.py`` (Perceptron/MLP/AdaLine/
+LogReg/LinReg) and ``main_onoszko_2021.py:28-56`` (CIFAR10Net). Here every
+model is a flax module; parameters are plain pytrees so N nodes' models stack
+into one leading-axis pytree for vmapped training. The ``Sizeable.get_size``
+protocol (reference gossipy/__init__.py:134-156) becomes :func:`param_count`
+— static arithmetic over the pytree, no per-message traversal.
+"""
+
+from .nn import (
+    AdaLine,
+    CIFAR10Net,
+    LinearRegression,
+    LogisticRegression,
+    Perceptron,
+    MLP,
+    param_count,
+)
+
+__all__ = [
+    "AdaLine", "CIFAR10Net", "LinearRegression", "LogisticRegression",
+    "Perceptron", "MLP", "param_count",
+]

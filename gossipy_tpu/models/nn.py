@@ -1,0 +1,132 @@
+"""Flax model definitions.
+
+Output conventions follow the reference exactly so handler/eval semantics
+carry over:
+
+- :class:`Perceptron` — sigmoid(linear) -> [B, 1] (reference nn.py:26-64)
+- :class:`MLP` — raw logits (reference nn.py:67-113; final layer linear)
+- :class:`LogisticRegression` — sigmoid(linear) -> [B, C] (reference nn.py:147-174;
+  yes, the reference feeds sigmoid outputs to CrossEntropyLoss — callers pick
+  the loss, we keep the forward identical)
+- :class:`LinearRegression` — linear (reference nn.py:176-198)
+- :class:`CIFAR10Net` — 3xConv+pool, 2xFC CNN (reference main_onoszko_2021.py:28-56);
+  NHWC layout for TPU-friendly convolutions
+- :class:`AdaLine` — a bare weight vector trained by manual delta rules
+  (reference nn.py:116-143); not a flax module, just an init helper
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def param_count(params) -> int:
+    """Total number of scalars in a parameter pytree.
+
+    Replaces ``TorchModel.get_size`` (reference gossipy/model/__init__.py:45-58);
+    used for message-size accounting in delay models and the report.
+    """
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+class Perceptron(nn.Module):
+    """Rosenblatt perceptron: sigmoid output neuron (reference nn.py:26-64)."""
+
+    dim: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(1, use_bias=self.use_bias,
+                     kernel_init=nn.initializers.xavier_uniform())(x)
+        return nn.sigmoid(h)
+
+
+class MLP(nn.Module):
+    """Multi-layer perceptron with configurable hidden dims (reference nn.py:67-113)."""
+
+    input_dim: int
+    output_dim: int
+    hidden_dims: Sequence[int] = (100,)
+    activation: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        for h in self.hidden_dims:
+            x = nn.Dense(h, kernel_init=nn.initializers.xavier_uniform())(x)
+            x = self.activation(x)
+        return nn.Dense(self.output_dim,
+                        kernel_init=nn.initializers.xavier_uniform())(x)
+
+
+class LogisticRegression(nn.Module):
+    """sigmoid(Wx + b) with C outputs (reference nn.py:147-174)."""
+
+    input_dim: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.sigmoid(nn.Dense(self.output_dim)(x))
+
+
+class LinearRegression(nn.Module):
+    """Wx + b (reference nn.py:176-198)."""
+
+    input_dim: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.output_dim)(x)
+
+
+class CIFAR10Net(nn.Module):
+    """Small CIFAR-10 CNN (reference main_onoszko_2021.py:28-56), NHWC.
+
+    conv(3->32,3x3) -> pool -> conv(32->64,3x3) -> pool -> conv(64->64,3x3)
+    -> pool -> fc(256->64) -> fc(64->10). VALID padding and 2x2 max-pool to
+    match the reference's spatial arithmetic (32->15->6->2).
+    """
+
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        # Accept NCHW input for API parity and transpose to NHWC for the MXU.
+        if x.shape[-1] != 3 and x.shape[1] == 3:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        init = nn.initializers.xavier_uniform()
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=init)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=init)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=init)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64, kernel_init=init)(x))
+        return nn.Dense(self.n_classes, kernel_init=init)(x)
+
+
+class AdaLine:
+    """AdaLine / Pegasos weight vector (reference nn.py:116-143).
+
+    Not a flax module: the model IS a zero-initialized [dim] vector and its
+    training rules are hand-written in the handlers (delta rule / Pegasos),
+    exactly as the reference bypasses autograd (``requires_grad=False``).
+    """
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def init(self) -> jax.Array:
+        return jnp.zeros((self.dim,), dtype=jnp.float32)
+
+    @staticmethod
+    def apply(w: jax.Array, x: jax.Array) -> jax.Array:
+        """Score = x @ w for a batch [B, dim] (reference nn.py:134-135)."""
+        return x @ w

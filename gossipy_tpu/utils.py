@@ -1,0 +1,188 @@
+"""Pure-JAX evaluation metrics and misc utilities.
+
+The reference computes metrics with sklearn on host (accuracy / macro
+precision / recall / F1 / binary ROC-AUC at gossipy/model/handler.py:282-334,
+NMI at handler.py:632-636, RMSE at handler.py:570-573). Those run once per
+node per round — on TPU we instead evaluate ALL nodes in one vmapped call, so
+every metric here is a jit-safe pure function over (scores, labels, mask)
+with static class counts. ``mask`` marks valid rows (1.0) vs padding (0.0),
+because per-node shards are padded to a common static length.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def confusion_matrix(y_true: jax.Array, y_pred: jax.Array, n_classes: int,
+                     mask: jax.Array | None = None) -> jax.Array:
+    """Masked confusion matrix [n_classes, n_classes] via one-hot matmul (MXU-friendly)."""
+    oh_t = jax.nn.one_hot(y_true, n_classes)
+    oh_p = jax.nn.one_hot(y_pred, n_classes)
+    if mask is not None:
+        oh_t = oh_t * mask[:, None]
+    return oh_t.T @ oh_p
+
+
+def _safe_div(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.where(b > 0, a / jnp.where(b > 0, b, 1.0), 0.0)
+
+
+def accuracy(y_true, y_pred, mask=None):
+    ok = (y_true == y_pred).astype(jnp.float32)
+    if mask is None:
+        return ok.mean()
+    return _safe_div((ok * mask).sum(), mask.sum())
+
+
+def macro_prf1(y_true: jax.Array, y_pred: jax.Array, n_classes: int,
+               mask: jax.Array | None = None) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Macro-averaged precision/recall/F1 with sklearn ``zero_division=0`` semantics.
+
+    Matches ``precision_score(..., average="macro", zero_division=0)`` as used
+    at reference handler.py:320-322: classes with zero predicted (resp. true)
+    support contribute 0 to macro precision (resp. recall); macro averages run
+    over ALL n_classes classes.
+    """
+    cm = confusion_matrix(y_true, y_pred, n_classes, mask)
+    tp = jnp.diag(cm)
+    pred_tot = cm.sum(axis=0)
+    true_tot = cm.sum(axis=1)
+    prec = _safe_div(tp, pred_tot)
+    rec = _safe_div(tp, true_tot)
+    f1 = _safe_div(2 * prec * rec, prec + rec)
+    return prec.mean(), rec.mean(), f1.mean()
+
+
+def binary_auc(scores: jax.Array, y_true: jax.Array,
+               mask: jax.Array | None = None) -> jax.Array:
+    """ROC-AUC for binary labels via the rank (Mann-Whitney U) formula with midranks.
+
+    Equivalent to sklearn's ``roc_auc_score`` (reference handler.py:325-331)
+    including tie handling. ``y_true`` in {0,1}. Sort-free-of-host: O(E log E).
+    Returns 0.5 if either class is absent (degenerate case).
+    """
+    scores = scores.astype(jnp.float32)
+    if mask is None:
+        mask = jnp.ones_like(scores)
+    mask = mask.astype(jnp.float32)
+    pos = (y_true > 0).astype(jnp.float32) * mask
+    neg = (y_true <= 0).astype(jnp.float32) * mask
+    # Push masked entries to +inf so they never affect counts below any finite score.
+    s_sorted = jnp.sort(jnp.where(mask > 0, scores, jnp.inf))
+    lo = jnp.searchsorted(s_sorted, scores, side="left").astype(jnp.float32)
+    hi = jnp.searchsorted(s_sorted, scores, side="right").astype(jnp.float32)
+    midrank = (lo + hi + 1.0) / 2.0  # 1-based average rank among valid entries
+    n_pos = pos.sum()
+    n_neg = neg.sum()
+    rank_sum_pos = (midrank * pos).sum()
+    u = rank_sum_pos - n_pos * (n_pos + 1.0) / 2.0
+    auc = _safe_div(u, n_pos * n_neg)
+    return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.5)
+
+
+def classification_metrics(scores: jax.Array, y_true: jax.Array, n_classes: int,
+                           mask: jax.Array | None = None) -> dict[str, jax.Array]:
+    """The reference's classification metric dict (handler.py:318-331), pure-JAX.
+
+    ``scores`` is [E, C] model outputs; prediction is argmax. When C == 2 the
+    dict includes "auc" computed from scores[:, 1].
+    """
+    y_pred = jnp.argmax(scores, axis=-1)
+    if y_true.ndim > 1:  # one-hot labels (reference handler.py:310-313)
+        y_true = jnp.argmax(y_true, axis=-1)
+    prec, rec, f1 = macro_prf1(y_true, y_pred, n_classes, mask)
+    res = {
+        "accuracy": accuracy(y_true, y_pred, mask),
+        "precision": prec,
+        "recall": rec,
+        "f1_score": f1,
+    }
+    if scores.shape[-1] == 2:
+        res["auc"] = binary_auc(scores[:, 1], y_true, mask)
+    return res
+
+
+def signed_binary_metrics(scores: jax.Array, y_true: jax.Array,
+                          mask: jax.Array | None = None) -> dict[str, jax.Array]:
+    """Metrics for ±1-labelled linear models (AdaLine/Pegasos).
+
+    Mirrors ``AdaLineHandler.evaluate`` (reference handler.py:375-391):
+    prediction = sign(score) mapped to {-1, +1}; macro P/R/F1 over the two
+    classes; AUC from raw scores.
+    """
+    y01 = (y_true > 0).astype(jnp.int32)
+    pred01 = (scores >= 0).astype(jnp.int32)
+    prec, rec, f1 = macro_prf1(y01, pred01, 2, mask)
+    return {
+        "accuracy": accuracy(y01, pred01, mask),
+        "precision": prec,
+        "recall": rec,
+        "f1_score": f1,
+        "auc": binary_auc(scores, y01, mask),
+    }
+
+
+def nmi(y_true: jax.Array, y_pred: jax.Array, n_true: int, n_pred: int,
+        mask: jax.Array | None = None) -> jax.Array:
+    """Normalized mutual information (arithmetic normalization).
+
+    Pure-JAX equivalent of sklearn's ``normalized_mutual_info_score`` used by
+    the k-means handler (reference handler.py:632-636).
+    """
+    cm = confusion_matrix(y_true, y_pred, max(n_true, n_pred), mask)
+    n = cm.sum()
+    pij = _safe_div(cm, n)
+    pi = pij.sum(axis=1, keepdims=True)
+    pj = pij.sum(axis=0, keepdims=True)
+    outer = pi * pj
+    mi = jnp.where((pij > 0) & (outer > 0),
+                   pij * jnp.log(_safe_div(pij, jnp.where(outer > 0, outer, 1.0))),
+                   0.0).sum()
+    h_i = -jnp.where(pi > 0, pi * jnp.log(jnp.where(pi > 0, pi, 1.0)), 0.0).sum()
+    h_j = -jnp.where(pj > 0, pj * jnp.log(jnp.where(pj > 0, pj, 1.0)), 0.0).sum()
+    denom = (h_i + h_j) / 2.0
+    return jnp.where(denom > 0, mi / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def rmse(pred: jax.Array, target: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """Masked RMSE (MF recommender metric, reference handler.py:570-573)."""
+    err2 = (pred - target) ** 2
+    if mask is None:
+        return jnp.sqrt(err2.mean())
+    return jnp.sqrt(_safe_div((err2 * mask).sum(), mask.sum()))
+
+
+def params_allclose(p1, p2, rtol: float = 1e-5, atol: float = 1e-7) -> bool:
+    """Pytree parameter equality (replaces ``torch_models_eq``, reference utils.py:67-95)."""
+    leaves1, tree1 = jax.tree_util.tree_flatten(p1)
+    leaves2, tree2 = jax.tree_util.tree_flatten(p2)
+    if tree1 != tree2:
+        return False
+    return all(bool(jnp.allclose(a, b, rtol=rtol, atol=atol))
+               for a, b in zip(leaves1, leaves2))
+
+
+def plot_evaluation(evals: list[list[dict[str, float]]], title: str = "Untitled plot",
+                    path: str | None = None):
+    """Mean±std curves per metric (reference utils.py:152-183)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    if not evals or not evals[0] or not evals[0][0]:
+        return None
+    fig = plt.figure()
+    for k in evals[0][0]:
+        series = np.array([[d[k] for d in rep] for rep in evals], dtype=float)
+        mu, sd = series.mean(axis=0), series.std(axis=0)
+        plt.fill_between(range(1, len(mu) + 1), mu - sd, mu + sd, alpha=0.2)
+        plt.plot(range(1, len(mu) + 1), mu, label=k)
+    plt.legend(loc="lower right")
+    plt.title(title)
+    plt.xlabel("round")
+    if path:
+        plt.savefig(path, bbox_inches="tight")
+    return fig

@@ -1,0 +1,21 @@
+"""Test configuration: run on CPU with 8 virtual devices.
+
+Multi-chip TPU hardware is not available in CI; sharded code paths (as they
+land) run on ``--xla_force_host_platform_device_count=8`` CPU devices — the
+same XLA partitioner and collectives as a real mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,103 @@
+"""Tests for enums, topologies, delays, mixing matrices (gossipy_tpu.core)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossipy_tpu.core import (
+    AntiEntropyProtocol,
+    ConstantDelay,
+    CreateModelMode,
+    LinearDelay,
+    MessageType,
+    Topology,
+    UniformDelay,
+    metropolis_hastings_mixing,
+    uniform_mixing,
+)
+
+
+def test_enums_match_reference_values():
+    # reference core.py:31-75
+    assert CreateModelMode.UPDATE == 1
+    assert CreateModelMode.MERGE_UPDATE == 2
+    assert CreateModelMode.UPDATE_MERGE == 3
+    assert CreateModelMode.PASS == 4
+    assert AntiEntropyProtocol.PUSH == 1
+    assert MessageType.REPLY == 3
+
+
+def test_clique_topology():
+    t = Topology.clique(5)
+    assert t.num_nodes == 5
+    assert (t.degrees == 4).all()
+    assert not t.adjacency.diagonal().any()
+    assert t.get_peers(2) == [0, 1, 3, 4]
+    # Node 0 reports its true degree (fixes reference core.py:346-349 quirk).
+    assert t.size(0) == 4
+    assert t.size() == 5
+
+
+def test_ring_topology():
+    t = Topology.ring(6, k=1)
+    assert (t.degrees == 2).all()
+    assert t.get_peers(0) == [1, 5]
+
+
+def test_random_regular_and_ba():
+    t = Topology.random_regular(20, 4, seed=1)
+    assert (t.degrees == 4).all()
+    ba = Topology.barabasi_albert(30, 2, seed=1)
+    assert ba.num_nodes == 30
+    assert (np.asarray(ba.adjacency) == np.asarray(ba.adjacency).T).all()
+
+
+def test_sample_peers_respects_adjacency(key):
+    t = Topology.ring(8, k=1)
+    for i in range(20):
+        peers = np.asarray(t.sample_peers(jax.random.fold_in(key, i)))
+        for n in range(8):
+            assert t.adjacency[n, peers[n]]
+
+
+def test_sample_peers_isolated_node(key):
+    a = np.zeros((3, 3), dtype=bool)
+    a[0, 1] = a[1, 0] = True
+    t = Topology(a)
+    peers = np.asarray(t.sample_peers(key))
+    assert peers[2] == -1
+    assert peers[0] == 1 and peers[1] == 0
+
+
+def test_delays(key):
+    assert ConstantDelay(3).max_delay(100) == 3
+    assert (np.asarray(ConstantDelay(3).sample(key, (5,), 10)) == 3).all()
+
+    d = UniformDelay(0, 10)
+    s = np.asarray(d.sample(key, (1000,), 10))
+    assert s.min() >= 0 and s.max() <= 10
+    assert d.max_delay(10) == 10
+
+    # LinearDelay(0, x) == ConstantDelay(x)  (reference core.py:269-271)
+    ld = LinearDelay(0.0, 4)
+    assert (np.asarray(ld.sample(key, (5,), 123)) == 4).all()
+    # delay = floor(timexunit*size) + overhead (reference core.py:285-304)
+    assert LinearDelay(0.5, 2).max_delay(11) == 7
+
+
+def test_uniform_mixing_rows_sum_to_one():
+    t = Topology.ring(6, k=1)
+    w = np.asarray(uniform_mixing(t))
+    assert np.allclose(w.sum(axis=1), 1.0)
+    # self weight equals peer weight: 1/(deg+1)  (reference core.py:419-434)
+    assert np.allclose(np.diag(w), 1.0 / 3.0)
+
+
+def test_mh_mixing_doubly_stochastic():
+    t = Topology.barabasi_albert(12, 2, seed=3)
+    w = np.asarray(metropolis_hastings_mixing(t))
+    assert np.allclose(w.sum(axis=1), 1.0, atol=1e-6)
+    assert np.allclose(w.sum(axis=0), 1.0, atol=1e-6)
+    assert np.allclose(w, w.T)
+    assert (np.diag(w) >= 0).all()

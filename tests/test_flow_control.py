@@ -1,0 +1,71 @@
+"""Token-account policies vs the reference formulas (flow_control.py:85-236)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossipy_tpu.flow_control import (
+    GeneralizedTokenAccount,
+    PurelyProactiveTokenAccount,
+    PurelyReactiveTokenAccount,
+    RandomizedTokenAccount,
+    SimpleTokenAccount,
+)
+
+
+def test_purely_proactive():
+    a = PurelyProactiveTokenAccount()
+    b = jnp.asarray([0, 5, 100])
+    assert (np.asarray(a.proactive(b)) == 1.0).all()
+    assert (np.asarray(a.reactive(b, jnp.ones(3), jax.random.PRNGKey(0))) == 0).all()
+
+
+def test_purely_reactive():
+    a = PurelyReactiveTokenAccount(k=3)
+    b = jnp.asarray([0, 5, 100])
+    assert (np.asarray(a.proactive(b)) == 0.0).all()
+    u = jnp.asarray([0.0, 1.0, 2.0])
+    assert list(np.asarray(a.reactive(b, u, jax.random.PRNGKey(0)))) == [0, 3, 6]
+
+
+def test_simple_token_account():
+    a = SimpleTokenAccount(C=3)
+    b = jnp.asarray([0, 2, 3, 7])
+    assert list(np.asarray(a.proactive(b))) == [0.0, 0.0, 1.0, 1.0]
+    u = jnp.ones(4)
+    assert list(np.asarray(a.reactive(b, u, jax.random.PRNGKey(0)))) == [0, 1, 1, 1]
+
+
+def test_generalized_reactive_formula():
+    a = GeneralizedTokenAccount(C=20, A=4)
+    balance = jnp.arange(0, 25)
+    useful = a.reactive(balance, jnp.ones(25), jax.random.PRNGKey(0))
+    useless = a.reactive(balance, jnp.zeros(25), jax.random.PRNGKey(0))
+    for i in range(25):
+        # reference flow_control.py:187-189
+        assert int(useful[i]) == (4 - 1 + i) // 4
+        assert int(useless[i]) == (4 - 1 + i) // 8
+
+
+def test_randomized_proactive_ramp():
+    a = RandomizedTokenAccount(C=20, A=10)
+    b = jnp.asarray([0, 8, 9, 15, 20, 25])
+    p = np.asarray(a.proactive(b))
+    # reference flow_control.py:223-229: 0 below A-1, linear to C, then 1.
+    assert p[0] == 0.0 and p[1] == 0.0
+    assert np.isclose(p[2], 0.0)
+    assert np.isclose(p[3], (15 - 9) / 11)
+    assert np.isclose(p[4], 1.0)
+    assert p[5] == 1.0
+
+
+def test_randomized_reactive_rand_round():
+    a = RandomizedTokenAccount(C=20, A=10)
+    key = jax.random.PRNGKey(0)
+    balance = jnp.full((2000,), 15)  # r = 1.5 -> mean reaction 1.5
+    r = np.asarray(a.reactive(balance, jnp.ones(2000), key))
+    assert set(np.unique(r)).issubset({1, 2})
+    assert abs(r.mean() - 1.5) < 0.1
+    # Useless messages never trigger reactions (flow_control.py:232-236).
+    r0 = np.asarray(a.reactive(balance, jnp.zeros(2000), key))
+    assert (r0 == 0).all()

@@ -1,0 +1,395 @@
+"""Handler unit tests: merge/update semantics vs hand-computed expectations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.compression import ModelPartition, sample_mask, sampled_merge
+from gossipy_tpu.core import CreateModelMode
+from gossipy_tpu.handlers import (
+    AdaLineHandler,
+    KMeansHandler,
+    LimitedMergeSGDHandler,
+    MFHandler,
+    ModelState,
+    PartitionedSGDHandler,
+    PeerModel,
+    PegasosHandler,
+    SamplingSGDHandler,
+    SGDHandler,
+    losses,
+)
+from gossipy_tpu.models import AdaLine, LogisticRegression, MLP
+
+
+def make_binary_data(n=64, d=8, seed=0, signed=False):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    if signed:
+        y = 2 * y - 1
+    mask = np.ones(n, dtype=np.float32)
+    return jnp.asarray(X), jnp.asarray(y), jnp.asarray(mask)
+
+
+# ---------------------------------------------------------------------------
+# SGD handler
+# ---------------------------------------------------------------------------
+
+class TestSGDHandler:
+    def make(self, d=8, mode=CreateModelMode.MERGE_UPDATE, **kw):
+        return SGDHandler(
+            model=LogisticRegression(d, 2),
+            loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.5),
+            local_epochs=kw.pop("local_epochs", 2),
+            batch_size=kw.pop("batch_size", 16),
+            n_classes=2,
+            input_shape=(d,),
+            create_model_mode=mode,
+            **kw,
+        )
+
+    def test_init_and_update_improves_accuracy(self, key):
+        h = self.make()
+        X, y, mask = make_binary_data()
+        st = h.init(key)
+        acc0 = float(h.evaluate(st, (X, y.astype(jnp.int32), mask))["accuracy"])
+        for i in range(15):
+            st = h.update(st, (X, y.astype(jnp.int32), mask), jax.random.fold_in(key, i))
+        acc1 = float(h.evaluate(st, (X, y.astype(jnp.int32), mask))["accuracy"])
+        assert acc1 > acc0
+        assert acc1 > 0.85
+        assert int(st.n_updates) == 15 * 2 * 4  # epochs * batches
+
+    def test_update_ignores_padding(self, key):
+        h = self.make(local_epochs=1, batch_size=8)
+        X, y, mask = make_binary_data(n=32)
+        # Pad with garbage rows that must not affect training.
+        Xp = jnp.concatenate([X, 1e3 * jnp.ones((16, 8))])
+        yp = jnp.concatenate([y, jnp.zeros(16)])
+        mp = jnp.concatenate([mask, jnp.zeros(16)])
+        st = h.init(key)
+        st_clean = h.update(st, (X, y.astype(jnp.int32), mask), key)
+        st_pad = h.update(st, (Xp, yp.astype(jnp.int32), mp), key)
+        # Same data through different batch layouts won't match exactly, but
+        # the padded run must stay finite and sane.
+        for leaf in jax.tree_util.tree_leaves(st_pad.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+        assert np.isfinite(
+            float(h.evaluate(st_pad, (X, y.astype(jnp.int32), mask))["accuracy"]))
+        del st_clean
+
+    def test_merge_is_uniform_average(self, key):
+        h = self.make()
+        st1 = h.init(key)
+        st2 = h.init(jax.random.fold_in(key, 1))
+        st1 = st1._replace(n_updates=jnp.int32(5))
+        st2 = st2._replace(n_updates=jnp.int32(9))
+        merged = h.merge(st1, PeerModel(st2.params, st2.n_updates))
+        expect = jax.tree.map(lambda a, b: (a + b) / 2, st1.params, st2.params)
+        for a, b in zip(jax.tree_util.tree_leaves(merged.params),
+                        jax.tree_util.tree_leaves(expect)):
+            assert np.allclose(a, b)
+        assert int(merged.n_updates) == 9  # max (handler.py:280)
+
+    def test_call_modes(self, key):
+        X, y, mask = make_binary_data()
+        data = (X, y.astype(jnp.int32), mask)
+        for mode in [CreateModelMode.UPDATE, CreateModelMode.MERGE_UPDATE,
+                     CreateModelMode.UPDATE_MERGE, CreateModelMode.PASS]:
+            h = self.make(mode=mode)
+            st = h.init(key)
+            peer_st = h.init(jax.random.fold_in(key, 7))
+            peer = PeerModel(peer_st.params, jnp.int32(3))
+            out = h.call(st, peer, data, jax.random.fold_in(key, 8))
+            assert isinstance(out, ModelState)
+            if mode == CreateModelMode.PASS:
+                for a, b in zip(jax.tree_util.tree_leaves(out.params),
+                                jax.tree_util.tree_leaves(peer.params)):
+                    assert np.allclose(a, b)
+
+    def test_batch_size_larger_than_shard(self, key):
+        # Regression: batch_size >> S must not crash the padded batching.
+        h = self.make(local_epochs=1, batch_size=32)
+        X, y, mask = make_binary_data(n=10)
+        st = h.init(key)
+        st = h.update(st, (X, y.astype(jnp.int32), mask), key)
+        assert int(st.n_updates) == 1
+        for leaf in jax.tree_util.tree_leaves(st.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_mlp_trains(self, key):
+        d = 8
+        h = SGDHandler(model=MLP(d, 2, hidden_dims=(16,)), loss=losses.cross_entropy,
+                       optimizer=optax.sgd(0.3), local_epochs=5, batch_size=16,
+                       n_classes=2, input_shape=(d,))
+        X, y, mask = make_binary_data(d=d)
+        st = h.init(key)
+        for i in range(5):
+            st = h.update(st, (X, y.astype(jnp.int32), mask), jax.random.fold_in(key, i))
+        acc = float(h.evaluate(st, (X, y.astype(jnp.int32), mask))["accuracy"])
+        assert acc > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Pegasos / AdaLine
+# ---------------------------------------------------------------------------
+
+class TestLinearHandlers:
+    def test_pegasos_matches_manual_loop(self, key):
+        d, n = 4, 10
+        h = PegasosHandler(AdaLine(d), learning_rate=0.1)
+        X, y, mask = make_binary_data(n=n, d=d, signed=True)
+        st = h.init(key)
+        out = h.update(st, (X, y, mask), key)
+
+        # Manual replication of reference handler.py:416-423.
+        w = np.zeros(d)
+        lam = 0.1
+        Xn, yn = np.asarray(X), np.asarray(y)
+        for i in range(n):
+            t = i + 1
+            eta = 1.0 / (t * lam)
+            score = w @ Xn[i]
+            w = w * (1 - eta * lam)
+            if score * yn[i] - 1 < 0:
+                w = w + eta * yn[i] * Xn[i]
+        assert np.allclose(np.asarray(out.params), w, atol=1e-5)
+        assert int(out.n_updates) == n
+
+    def test_pegasos_masked_samples_skipped(self, key):
+        d = 4
+        h = PegasosHandler(AdaLine(d), learning_rate=0.1)
+        X, y, _ = make_binary_data(n=10, d=d, signed=True)
+        mask = jnp.asarray([1, 1, 1, 0, 0, 0, 0, 0, 0, 0], dtype=jnp.float32)
+        out = h.update(h.init(key), (X, y, mask), key)
+        out2 = h.update(h.init(key), (X[:3], y[:3], jnp.ones(3)), key)
+        assert np.allclose(np.asarray(out.params), np.asarray(out2.params), atol=1e-6)
+        assert int(out.n_updates) == 3
+
+    def test_adaline_update_and_merge(self, key):
+        d = 4
+        h = AdaLineHandler(AdaLine(d), learning_rate=0.05)
+        X, y, mask = make_binary_data(n=20, d=d, signed=True)
+        st = h.update(h.init(key), (X, y, mask), key)
+        assert int(st.n_updates) == 20
+        peer = PeerModel(jnp.ones(d), jnp.int32(7))
+        merged = h.merge(st, peer)
+        assert np.allclose(np.asarray(merged.params),
+                           0.5 * (np.asarray(st.params) + 1.0))
+        assert int(merged.n_updates) == 20
+
+    def test_pegasos_learns(self, key):
+        d = 8
+        h = PegasosHandler(AdaLine(d), learning_rate=0.01)
+        X, y, mask = make_binary_data(n=200, d=d, signed=True)
+        st = h.init(key)
+        for _ in range(3):
+            st = h.update(st, (X, y, mask), key)
+        res = h.evaluate(st, (X, y, mask))
+        assert float(res["accuracy"]) > 0.9
+        assert float(res["auc"]) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Compression: partitioning and sampling
+# ---------------------------------------------------------------------------
+
+class TestCompression:
+    def test_partition_covers_all_coordinates(self, key):
+        h = SGDHandler(model=MLP(6, 3, hidden_dims=(5,)), loss=losses.cross_entropy,
+                       n_classes=3, input_shape=(6,))
+        params = h.init(key).params
+        part = ModelPartition(params, 4)
+        ids = np.concatenate([np.asarray(l).ravel()
+                              for l in jax.tree_util.tree_leaves(part.part_ids)])
+        total = ids.size
+        # Every coordinate gets exactly one part; sizes differ by <= 1.
+        assert part.sizes.sum() == total
+        assert part.sizes.max() - part.sizes.min() <= 1
+        assert set(np.unique(ids)) == set(range(4))
+
+    def test_partition_merge_only_touches_partition(self, key):
+        h = SGDHandler(model=LogisticRegression(6, 2), loss=losses.cross_entropy,
+                       n_classes=2, input_shape=(6,))
+        p1 = h.init(key).params
+        p2 = jax.tree.map(lambda a: a + 1.0, p1)
+        part = ModelPartition(p1, 3)
+        merged = part.merge(p1, p2, 1, weights=(1, 1))
+        for leaf_m, leaf_1, ids in zip(jax.tree_util.tree_leaves(merged),
+                                       jax.tree_util.tree_leaves(p1),
+                                       jax.tree_util.tree_leaves(part.part_ids)):
+            in_part = np.asarray(ids) == 1
+            np.testing.assert_allclose(np.asarray(leaf_m)[~in_part],
+                                       np.asarray(leaf_1)[~in_part])
+            np.testing.assert_allclose(np.asarray(leaf_m)[in_part],
+                                       np.asarray(leaf_1)[in_part] + 0.5,
+                                       rtol=1e-6)
+
+    def test_partition_merge_age_weighting(self, key):
+        p1 = {"w": jnp.zeros((4,))}
+        p2 = {"w": jnp.ones((4,))}
+        part = ModelPartition(p1, 1)
+        merged = part.merge(p1, p2, 0, weights=(3, 1))
+        np.testing.assert_allclose(np.asarray(merged["w"]), 0.25, rtol=1e-6)
+        # weights (0,0) -> plain average (sampling.py:228)
+        merged = part.merge(p1, p2, 0, weights=(jnp.int32(0), jnp.int32(0)))
+        np.testing.assert_allclose(np.asarray(merged["w"]), 0.5, rtol=1e-6)
+
+    def test_sample_mask_fraction_and_merge(self, key):
+        params = {"a": jnp.zeros((100, 100)), "b": jnp.zeros((500,))}
+        mask = sample_mask(key, params, 0.3)
+        frac = np.mean([np.asarray(m).mean() for m in jax.tree_util.tree_leaves(mask)])
+        assert abs(frac - 0.3) < 0.05
+        p2 = {"a": jnp.ones((100, 100)), "b": jnp.ones((500,))}
+        merged = sampled_merge(params, p2, mask)
+        a = np.asarray(merged["a"])
+        assert set(np.unique(a)).issubset({0.0, 0.5})
+
+
+# ---------------------------------------------------------------------------
+# Partitioned / sampled / limited-merge handlers
+# ---------------------------------------------------------------------------
+
+class TestSGDVariants:
+    def test_partitioned_handler_roundtrip(self, key):
+        d = 6
+        base = SGDHandler(model=LogisticRegression(d, 2), loss=losses.cross_entropy,
+                          n_classes=2, input_shape=(d,))
+        params = base.init(key).params
+        part = ModelPartition(params, 4)
+        h = PartitionedSGDHandler(part, model=LogisticRegression(d, 2),
+                                  loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
+                                  local_epochs=1, batch_size=8, n_classes=2,
+                                  input_shape=(d,))
+        st = h.init(key)
+        assert st.n_updates.shape == (4,)
+        X, y, mask = make_binary_data(n=16, d=d)
+        st = h.update(st, (X, y.astype(jnp.int32), mask), key)
+        assert (np.asarray(st.n_updates) == 2).all()  # 2 batches, all parts age together
+        peer = PeerModel(jax.tree.map(lambda a: a + 1.0, st.params),
+                         jnp.asarray([5, 5, 5, 5], dtype=jnp.int32))
+        merged = h.merge(st, peer, extra=jnp.int32(2))
+        assert int(merged.n_updates[2]) == 5
+        assert int(merged.n_updates[0]) == 2
+
+    def test_sampling_handler_merge(self, key):
+        d = 6
+        h = SamplingSGDHandler(0.5, model=LogisticRegression(d, 2),
+                               loss=losses.cross_entropy, n_classes=2,
+                               input_shape=(d,))
+        st = h.init(key)
+        peer = PeerModel(jax.tree.map(lambda a: a + 2.0, st.params), jnp.int32(3))
+        merged = h.merge(st, peer, extra=jax.random.fold_in(key, 9))
+        diff = np.concatenate([
+            (np.asarray(m) - np.asarray(o)).ravel()
+            for m, o in zip(jax.tree_util.tree_leaves(merged.params),
+                            jax.tree_util.tree_leaves(st.params))])
+        assert set(np.round(np.unique(diff), 5)).issubset({0.0, 1.0})
+        assert int(merged.n_updates) == 0  # sampling merge keeps age
+
+    def test_limited_merge_age_gate(self, key):
+        d = 4
+        h = LimitedMergeSGDHandler(model=LogisticRegression(d, 2),
+                                   loss=losses.cross_entropy, n_classes=2,
+                                   input_shape=(d,), age_diff_threshold=2)
+        st = h.init(key)._replace(n_updates=jnp.int32(10))
+        peer_params = jax.tree.map(lambda a: a + 1.0, st.params)
+        # Peer too old a gap below: self kept.
+        merged = h.merge(st, PeerModel(peer_params, jnp.int32(1)))
+        for a, b in zip(jax.tree_util.tree_leaves(merged.params),
+                        jax.tree_util.tree_leaves(st.params)):
+            assert np.allclose(a, b)
+        # Peer much older: adopted wholesale.
+        merged = h.merge(st, PeerModel(peer_params, jnp.int32(50)))
+        for a, b in zip(jax.tree_util.tree_leaves(merged.params),
+                        jax.tree_util.tree_leaves(peer_params)):
+            assert np.allclose(a, b)
+        # Close ages: age-weighted average.
+        merged = h.merge(st, PeerModel(peer_params, jnp.int32(10)))
+        for a, b in zip(jax.tree_util.tree_leaves(merged.params),
+                        jax.tree_util.tree_leaves(st.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b) + 0.5, atol=1e-6)
+        # Regression: two age-0 models average instead of zeroing out.
+        st0 = st._replace(n_updates=jnp.int32(0))
+        merged = h.merge(st0, PeerModel(peer_params, jnp.int32(0)))
+        for a, b in zip(jax.tree_util.tree_leaves(merged.params),
+                        jax.tree_util.tree_leaves(st.params)):
+            assert np.allclose(np.asarray(a), np.asarray(b) + 0.5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MF and KMeans
+# ---------------------------------------------------------------------------
+
+class TestMFHandler:
+    def test_update_reduces_rmse(self, key):
+        n_items = 50
+        h = MFHandler(dim=4, n_items=n_items, learning_rate=0.05)
+        rng = np.random.default_rng(0)
+        items = jnp.asarray(rng.integers(0, n_items, size=30))
+        ratings = jnp.asarray(rng.uniform(1, 5, size=30).astype(np.float32))
+        mask = jnp.ones(30)
+        st = h.init(key)
+        r0 = float(h.evaluate(st, (items, ratings, mask))["rmse"])
+        for i in range(30):
+            st = h.update(st, (items, ratings, mask), key)
+        r1 = float(h.evaluate(st, (items, ratings, mask))["rmse"])
+        assert r1 < r0
+        assert r1 < 1.0
+        assert int(st.n_updates) == 1 + 30 * 30
+
+    def test_merge_weighted_average_of_item_state(self, key):
+        h = MFHandler(dim=2, n_items=3)
+        st = h.init(key)._replace(n_updates=jnp.int32(3))
+        peer_params = jax.tree.map(lambda a: a * 0 + 2.0, st.params)
+        merged = h.merge(st, PeerModel(peer_params, jnp.int32(1)))
+        expect_Y = (np.asarray(st.params["Y"]) * 3 + 2.0 * 1) / 4
+        np.testing.assert_allclose(np.asarray(merged["Y"] if isinstance(merged, dict)
+                                              else merged.params["Y"]), expect_Y,
+                                   rtol=1e-6)
+        # User state untouched.
+        np.testing.assert_allclose(np.asarray(merged.params["X"]),
+                                   np.asarray(st.params["X"]))
+
+    def test_get_size(self):
+        h = MFHandler(dim=5, n_items=100)
+        assert h.get_size() == 5 * 101  # handler.py:575-576
+
+
+class TestKMeansHandler:
+    def make_blobs(self, seed=0):
+        # Blobs inside the unit square: the handler inits centroids ~U(0,1)
+        # (reference handler.py:594-595), so data must live at that scale.
+        rng = np.random.default_rng(seed)
+        centers = np.array([[0.1, 0.1], [0.9, 0.9], [0.1, 0.9]], dtype=np.float32)
+        X = np.concatenate([rng.normal(c, 0.05, size=(40, 2)) for c in centers])
+        y = np.repeat(np.arange(3), 40)
+        return jnp.asarray(X.astype(np.float32)), jnp.asarray(y), jnp.ones(120)
+
+    def test_clustering_improves_nmi(self, key):
+        h = KMeansHandler(k=3, dim=2, alpha=0.2)
+        X, y, mask = self.make_blobs()
+        st = h.init(key)
+        for _ in range(50):
+            st = h.update(st, (X, y, mask), key)
+        res = h.evaluate(st, (X, y, mask))
+        assert float(res["nmi"]) > 0.8
+
+    def test_merge_naive_and_matched(self, key):
+        h = KMeansHandler(k=3, dim=2, matching="naive")
+        c1 = jnp.asarray([[0.0, 0.0], [5.0, 5.0], [0.0, 5.0]])
+        c2_permuted = jnp.asarray([[5.1, 5.1], [0.1, 5.1], [0.1, 0.1]])
+        st = ModelState(c1, (), jnp.int32(1))
+        peer = PeerModel(c2_permuted, jnp.int32(1))
+        naive = h.merge(st, peer)
+        assert not np.allclose(np.asarray(naive.params), np.asarray(c1), atol=0.5)
+
+        hm = KMeansHandler(k=3, dim=2, matching="hungarian")
+        matched = hm.merge(st, peer)
+        np.testing.assert_allclose(np.asarray(matched.params), np.asarray(c1),
+                                   atol=0.1)

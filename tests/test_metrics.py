@@ -1,0 +1,99 @@
+"""Pure-JAX metrics vs sklearn ground truth (gossipy_tpu.utils)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score,
+    f1_score,
+    normalized_mutual_info_score,
+    precision_score,
+    recall_score,
+    roc_auc_score,
+)
+
+from gossipy_tpu.utils import (
+    binary_auc,
+    classification_metrics,
+    nmi,
+    rmse,
+    signed_binary_metrics,
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_classification_metrics_match_sklearn(seed):
+    rng = np.random.default_rng(seed)
+    n, c = 200, 4
+    scores = rng.normal(size=(n, c)).astype(np.float32)
+    y = rng.integers(0, c, size=n)
+    res = classification_metrics(scores, y, c)
+    y_pred = scores.argmax(axis=1)
+    assert np.isclose(float(res["accuracy"]), accuracy_score(y, y_pred))
+    assert np.isclose(float(res["precision"]),
+                      precision_score(y, y_pred, zero_division=0, average="macro"),
+                      atol=1e-6)
+    assert np.isclose(float(res["recall"]),
+                      recall_score(y, y_pred, zero_division=0, average="macro"),
+                      atol=1e-6)
+    assert np.isclose(float(res["f1_score"]),
+                      f1_score(y, y_pred, zero_division=0, average="macro"),
+                      atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_binary_auc_matches_sklearn(seed):
+    rng = np.random.default_rng(seed)
+    n = 300
+    s = rng.normal(size=n).astype(np.float32)
+    # Introduce ties to exercise midrank handling.
+    s = np.round(s, 1)
+    y = rng.integers(0, 2, size=n)
+    assert np.isclose(float(binary_auc(s, y)), roc_auc_score(y, s), atol=1e-6)
+
+
+def test_binary_auc_respects_mask():
+    rng = np.random.default_rng(3)
+    n = 100
+    s = rng.normal(size=n).astype(np.float32)
+    y = rng.integers(0, 2, size=n)
+    mask = (rng.random(n) < 0.7).astype(np.float32)
+    keep = mask > 0
+    expect = roc_auc_score(y[keep], s[keep])
+    assert np.isclose(float(binary_auc(s, y, mask)), expect, atol=1e-6)
+
+
+def test_binary_metrics_includes_auc():
+    rng = np.random.default_rng(0)
+    scores = rng.normal(size=(50, 2)).astype(np.float32)
+    y = rng.integers(0, 2, size=50)
+    res = classification_metrics(scores, y, 2)
+    assert "auc" in res
+    assert np.isclose(float(res["auc"]), roc_auc_score(y, scores[:, 1]), atol=1e-6)
+
+
+def test_signed_binary_metrics():
+    rng = np.random.default_rng(1)
+    s = rng.normal(size=80).astype(np.float32)
+    y = np.where(rng.random(80) < 0.5, -1.0, 1.0).astype(np.float32)
+    res = signed_binary_metrics(s, y)
+    y01 = (y > 0).astype(int)
+    pred = (s >= 0).astype(int)
+    assert np.isclose(float(res["accuracy"]), accuracy_score(y01, pred))
+    assert np.isclose(float(res["auc"]), roc_auc_score(y01, s), atol=1e-6)
+
+
+def test_nmi_matches_sklearn():
+    rng = np.random.default_rng(2)
+    y_true = rng.integers(0, 3, size=200)
+    y_pred = rng.integers(0, 3, size=200)
+    assert np.isclose(float(nmi(y_true, y_pred, 3, 3)),
+                      normalized_mutual_info_score(y_true, y_pred), atol=1e-5)
+    # Perfect agreement => 1 (up to float32 log precision).
+    assert np.isclose(float(nmi(y_true, y_true, 3, 3)), 1.0, atol=1e-4)
+
+
+def test_rmse_masked():
+    pred = np.array([1.0, 2.0, 100.0], dtype=np.float32)
+    tgt = np.array([1.0, 4.0, 0.0], dtype=np.float32)
+    mask = np.array([1.0, 1.0, 0.0], dtype=np.float32)
+    assert np.isclose(float(rmse(pred, tgt, mask)), np.sqrt(2.0), atol=1e-6)
