@@ -1,0 +1,486 @@
+"""Data layer: loaders, non-IID assignment, and the shard dispatcher.
+
+Re-design of ``gossipy/data/__init__.py`` (778 LoC). Assignment and loading
+stay host-side numpy (they run once at setup, reference SURVEY §7 stage 8);
+what changes is the *output*: :meth:`DataDispatcher.stacked` pads every
+node's shard to one static length and returns stacked device arrays
+``(X [N, S, ...], y [N, S], mask [N, S])`` so the whole network's local
+training is a single vmapped program. ``mask`` flags real rows (padding
+contributes nothing to losses/metrics).
+
+Non-IID partitioners mirror ``AssignmentHandler``
+(reference data/__init__.py:164-373) algorithm-for-algorithm.
+
+Dataset loaders: sklearn built-ins work offline; UCI/torchvision/MovieLens
+downloads are attempted and fall back to deterministic synthetic datasets of
+the same shape when the environment has no egress (the fallback is flagged
+in the returned metadata and by a warning).
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import Callable, Optional
+
+import numpy as np
+
+from .handler import (
+    ClassificationDataHandler,
+    ClusteringDataHandler,
+    DataHandler,
+    RecSysDataHandler,
+    RegressionDataHandler,
+)
+
+LOG = logging.getLogger("gossipy_tpu")
+
+__all__ = [
+    "AssignmentHandler", "DataDispatcher", "RecSysDataDispatcher",
+    "ClassificationDataHandler", "ClusteringDataHandler",
+    "RegressionDataHandler", "RecSysDataHandler", "DataHandler",
+    "load_classification_dataset", "load_recsys_dataset",
+    "get_CIFAR10", "get_FashionMNIST",
+]
+
+# UCI datasets the reference downloads (data/__init__.py:45-52): name ->
+# (n_samples, n_features, n_classes) used for the synthetic fallback shapes.
+UCI_SHAPES = {
+    "spambase": (4601, 57, 2),
+    "sonar": (208, 60, 2),
+    "ionosphere": (351, 34, 2),
+    "abalone": (4177, 8, 3),
+    "banknote": (1372, 4, 2),
+    "reuters": (8000, 100, 2),
+}
+
+
+# ---------------------------------------------------------------------------
+# Non-IID assignment (reference data/__init__.py:164-373)
+# ---------------------------------------------------------------------------
+
+class AssignmentHandler:
+    """Partitioners mapping labels -> per-client index arrays.
+
+    Each method mirrors the same-named reference method; all randomness goes
+    through one ``numpy.random.Generator`` seeded at construction (the
+    reference seeds the global numpy/torch RNGs, data/__init__.py:165-167).
+    """
+
+    def __init__(self, seed: int = 42):
+        self.rng = np.random.default_rng(seed)
+
+    def uniform(self, y: np.ndarray, n: int) -> list[np.ndarray]:
+        """Equal-size random shards (reference :170-189)."""
+        ex_client = y.shape[0] // n
+        idx = self.rng.permutation(y.shape[0])
+        return [idx[ex_client * i: ex_client * (i + 1)] for i in range(n)]
+
+    def quantity_skew(self, y: np.ndarray, n: int, min_quantity: int = 2,
+                      alpha: float = 4.0) -> list[np.ndarray]:
+        """Power-law shard sizes, ``min_quantity`` guaranteed (reference :191-228)."""
+        assert min_quantity * n <= y.shape[0], \
+            "# of instances must be > than min_quantity*n"
+        assert min_quantity > 0, "min_quantity must be >= 1"
+        s = (self.rng.power(alpha, y.shape[0] - min_quantity * n) * n).astype(int)
+        m = np.repeat(np.arange(n), min_quantity)
+        assignment = np.concatenate([s, m])
+        self.rng.shuffle(assignment)
+        return [np.where(assignment == i)[0] for i in range(n)]
+
+    def classwise_quantity_skew(self, y: np.ndarray, n: int, min_quantity: int = 2,
+                                alpha: float = 4.0) -> list[np.ndarray]:
+        """Per-class power-law splits (reference :230-255)."""
+        assert min_quantity * n <= y.shape[0], \
+            "# of instances must be > than min_quantity*n"
+        assert min_quantity > 0, "min_quantity must be >= 1"
+        labels = np.unique(y)
+        lens = [int((y == c).sum()) for c in labels]
+        assert min(lens) >= n, "Under represented class!"
+        res: list[list[int]] = [[] for _ in range(n)]
+        for c, ln in zip(labels, lens):
+            s = (self.rng.power(alpha, ln - n) * n).astype(int)
+            ass = np.concatenate([s, np.arange(n)])
+            self.rng.shuffle(ass)
+            idc = np.where(y == c)[0]
+            for i in range(n):
+                res[i].extend(idc[np.where(ass == i)[0]])
+        return [np.array(sorted(r), dtype=int) for r in res]
+
+    def label_quantity_skew(self, y: np.ndarray, n: int,
+                            class_per_client: int = 2) -> list[np.ndarray]:
+        """k-classes-per-client split (reference :257-298, Li et al. 2021)."""
+        labels = set(np.unique(y).tolist())
+        assert 0 < class_per_client <= len(labels), \
+            "class_per_client must be > 0 and <= #classes"
+        assert class_per_client * n >= len(labels), \
+            "class_per_client * n must be >= #classes"
+        nlbl = [self.rng.choice(len(labels), class_per_client, replace=False)
+                for _ in range(n)]
+        covered = set().union(*[set(a.tolist()) for a in nlbl])
+        while len(covered) < len(labels):
+            for missing in labels - covered:
+                nlbl[self.rng.integers(0, n)][self.rng.integers(0, class_per_client)] = missing
+            covered = set().union(*[set(a.tolist()) for a in nlbl])
+        class_map = {c: [u for u, lbl in enumerate(nlbl) if c in lbl] for c in labels}
+        assignment = np.zeros(y.shape[0], dtype=int)
+        for lbl, users in class_map.items():
+            ids = np.where(y == lbl)[0]
+            assignment[ids] = self.rng.choice(users, len(ids))
+        return [np.where(assignment == i)[0] for i in range(n)]
+
+    def label_dirichlet_skew(self, y: np.ndarray, n: int,
+                             beta: float = 0.1) -> list[np.ndarray]:
+        """Dirichlet(beta) class allocation (reference :300-335); each client
+        gets at least one example of each class (the ``ids[:n]`` seeding)."""
+        assert beta > 0, "beta must be > 0"
+        labels = np.unique(y)
+        assignment = np.zeros(y.shape[0], dtype=int)
+        for c in labels:
+            pk = self.rng.dirichlet([beta] * n)
+            ids = np.where(y == c)[0]
+            self.rng.shuffle(ids)
+            assignment[ids[n:]] = self.rng.choice(n, size=max(len(ids) - n, 0), p=pk)
+            assignment[ids[:n]] = np.arange(min(n, len(ids)))
+        return [np.where(assignment == i)[0] for i in range(n)]
+
+    def label_pathological_skew(self, y: np.ndarray, n: int,
+                                shards_per_client: int = 2) -> list[np.ndarray]:
+        """Sorted-shard split à la McMahan 2017 (reference :337-373)."""
+        sorted_ids = np.argsort(y, kind="stable")
+        n_shards = int(shards_per_client * n)
+        shard_size = int(np.ceil(len(y) / n_shards))
+        assignment = np.zeros(y.shape[0], dtype=int)
+        perm = self.rng.permutation(n_shards)
+        j = 0
+        for i in range(n):
+            for _ in range(shards_per_client):
+                left = perm[j] * shard_size
+                right = min((perm[j] + 1) * shard_size, len(y))
+                assignment[sorted_ids[left:right]] = i
+                j += 1
+        return [np.where(assignment == i)[0] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Dispatchers
+# ---------------------------------------------------------------------------
+
+class DataDispatcher:
+    """Assigns data shards to nodes and emits stacked padded device arrays.
+
+    API parity with reference data/__init__.py:376-510 (``__getitem__(idx) ->
+    (train, test)``, ``get_eval_set``, ``has_test``, ``size``), plus the
+    TPU-native :meth:`stacked` view used by the simulation engine.
+    """
+
+    def __init__(self, data_handler, n: int = 0, eval_on_user: bool = True,
+                 auto_assign: bool = True,
+                 assignment: Optional[Callable] = None,
+                 **assignment_kwargs):
+        assert data_handler.size() >= n, "Not enough data to dispatch"
+        self.data_handler = data_handler
+        self.n = n if n > 0 else data_handler.size()
+        self.eval_on_user = eval_on_user
+        self.tr_assignments: Optional[list[np.ndarray]] = None
+        self.te_assignments: Optional[list[np.ndarray]] = None
+        self._assignment_fn = assignment
+        self._assignment_kwargs = assignment_kwargs
+        if auto_assign:
+            self.assign()
+
+    def assign(self, seed: int = 42) -> None:
+        """Split train (and optionally eval) indices across the n nodes
+        (reference :435-451, default uniform)."""
+        handler = AssignmentHandler(seed)
+        fn = self._assignment_fn or AssignmentHandler.uniform
+        _, ytr = self.data_handler.get_train_set()
+        self.tr_assignments = fn(handler, np.asarray(ytr), self.n,
+                                 **self._assignment_kwargs)
+        if self.eval_on_user and self.data_handler.eval_size() > 0:
+            ev = self.data_handler.get_eval_set()
+            self.te_assignments = AssignmentHandler(seed).uniform(
+                np.asarray(ev[1]), self.n)
+        else:
+            self.te_assignments = [np.array([], dtype=int) for _ in range(self.n)]
+
+    def set_assignments(self, tr: list[np.ndarray],
+                        te: Optional[list[np.ndarray]] = None) -> None:
+        """Custom splits (reference :472-481, used by main_onoszko's
+        contiguous dispatcher)."""
+        assert len(tr) == self.n
+        self.tr_assignments = [np.asarray(a, dtype=int) for a in tr]
+        if te is not None:
+            self.te_assignments = [np.asarray(a, dtype=int) for a in te]
+        else:
+            self.te_assignments = [np.array([], dtype=int) for _ in range(self.n)]
+
+    def __getitem__(self, idx: int):
+        """Node idx's (train, test) shards (reference :454-470)."""
+        assert 0 <= idx < self.n, "Index %d out of range [0, %d)" % (idx, self.n)
+        return (self.data_handler.at(self.tr_assignments[idx]),
+                self.data_handler.at(self.te_assignments[idx], eval_set=True))
+
+    def size(self) -> int:
+        return self.n
+
+    def get_eval_set(self):
+        return self.data_handler.get_eval_set()
+
+    def has_test(self) -> bool:
+        return self.data_handler.eval_size() > 0
+
+    # -- TPU-native stacked view -------------------------------------------
+
+    @staticmethod
+    def _pad_stack(arrs: list[np.ndarray], pad_to: Optional[int] = None):
+        """Stack variable-length arrays into [N, S, ...] + mask [N, S]."""
+        s_max = max((a.shape[0] for a in arrs), default=0)
+        if pad_to is not None:
+            s_max = max(s_max, pad_to)
+        s_max = max(s_max, 1)
+        n = len(arrs)
+        out = np.zeros((n, s_max) + arrs[0].shape[1:], dtype=arrs[0].dtype)
+        mask = np.zeros((n, s_max), dtype=np.float32)
+        for i, a in enumerate(arrs):
+            out[i, : a.shape[0]] = a
+            mask[i, : a.shape[0]] = 1.0
+        return out, mask
+
+    def stacked(self, pad_to: Optional[int] = None) -> dict:
+        """Stacked padded shards for the whole network.
+
+        Returns a dict of numpy arrays (engine moves them to device):
+        ``xtr [N,S,...], ytr [N,S], mtr [N,S]`` and, when eval data exists,
+        ``xte/yte/mte`` (per-node) and ``x_eval/y_eval`` (the global eval
+        set, shared by all nodes).
+        """
+        assert self.tr_assignments is not None, "call assign() first"
+        Xtr, ytr = self.data_handler.get_train_set()
+        Xtr, ytr = np.asarray(Xtr), np.asarray(ytr)
+        xs = [Xtr[a] for a in self.tr_assignments]
+        ys = [ytr[a] for a in self.tr_assignments]
+        x_stack, mask = self._pad_stack(xs, pad_to)
+        y_stack, _ = self._pad_stack(ys, x_stack.shape[1])
+        out = {"xtr": x_stack, "ytr": y_stack, "mtr": mask}
+        if self.has_test():
+            Xte, yte = self.data_handler.get_eval_set()
+            Xte, yte = np.asarray(Xte), np.asarray(yte)
+            if self.eval_on_user:
+                xs = [Xte[a] for a in self.te_assignments]
+                ys = [yte[a] for a in self.te_assignments]
+                x_stack, mask = self._pad_stack(xs)
+                y_stack, _ = self._pad_stack(ys, x_stack.shape[1])
+                out.update({"xte": x_stack, "yte": y_stack, "mte": mask})
+            out.update({"x_eval": Xte, "y_eval": yte})
+        return out
+
+    def __str__(self) -> str:
+        return (f"DataDispatcher(handler={self.data_handler.__class__.__name__}, "
+                f"n={self.n}, eval_on_user={self.eval_on_user})")
+
+
+class RecSysDataDispatcher(DataDispatcher):
+    """One user-row per node, permuted (reference data/__init__.py:513-558)."""
+
+    def __init__(self, data_handler: RecSysDataHandler):
+        self.data_handler = data_handler
+        self.n = data_handler.size()
+        self.eval_on_user = True
+        self.assign()
+
+    def assign(self, seed: int = 42) -> None:
+        rng = np.random.default_rng(seed)
+        self.assignments = rng.permutation(self.n)
+
+    def __getitem__(self, idx: int):
+        u = int(self.assignments[idx])
+        return (self.data_handler.at(u), self.data_handler.at(u, eval_set=True))
+
+    def has_test(self) -> bool:
+        return True
+
+    def get_eval_set(self):
+        return None
+
+    def stacked(self, pad_to: Optional[int] = None) -> dict:
+        """Per-node rating shards: ``items [N,S], ratings [N,S], mask [N,S]``
+        for train and eval splits."""
+        def pack(eval_set: bool):
+            items, rates = [], []
+            for i in range(self.n):
+                r = self.data_handler.at(int(self.assignments[i]), eval_set=eval_set)
+                items.append(np.array([it for it, _ in r], dtype=np.int32))
+                rates.append(np.array([v for _, v in r], dtype=np.float32))
+            it_stack, mask = self._pad_stack(items, pad_to)
+            rt_stack, _ = self._pad_stack(rates, pad_to)
+            return it_stack, rt_stack, mask
+
+        itr, rtr, mtr = pack(False)
+        ite, rte, mte = pack(True)
+        return {"xtr": itr, "ytr": rtr, "mtr": mtr,
+                "xte": ite, "yte": rte, "mte": mte}
+
+
+# ---------------------------------------------------------------------------
+# Dataset loaders (reference data/__init__.py:561-778)
+# ---------------------------------------------------------------------------
+
+def _synthetic_classification(name: str, n: int, d: int, c: int,
+                              seed: Optional[int] = None):
+    """Deterministic synthetic stand-in for a non-downloadable dataset.
+
+    A Gaussian-mixture classification problem keyed on the dataset name so
+    shapes and difficulty are stable across runs (crc32, not ``hash`` —
+    Python string hashing is salted per process).
+    """
+    import zlib
+    rng = np.random.default_rng(zlib.crc32(name.encode()) if seed is None else seed)
+    centers = rng.normal(scale=1.5, size=(c, d))
+    per = n // c
+    Xs, ys = [], []
+    for k in range(c):
+        cnt = per + (1 if k < n % c else 0)
+        Xs.append(rng.normal(loc=centers[k], scale=1.0, size=(cnt, d)))
+        ys.append(np.full(cnt, k))
+    X = np.concatenate(Xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int64)
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def load_classification_dataset(name: str = "spambase", normalize: bool = True,
+                                allow_synthetic: bool = True):
+    """Load a classification dataset as (X [n, d] float32, y [n] int64).
+
+    Mirrors reference data/__init__.py:561-624: sklearn built-ins
+    (iris/breast/digits/wine) load locally; the UCI names
+    (spambase/sonar/ionosphere/abalone/banknote/reuters) are downloaded by
+    the reference — in an egress-less environment we substitute a
+    deterministic synthetic dataset with the same shape and warn.
+    """
+    name = name.lower()
+    if name == "iris":
+        from sklearn.datasets import load_iris
+        X, y = load_iris(return_X_y=True)
+    elif name in ("breast", "breast_cancer"):
+        from sklearn.datasets import load_breast_cancer
+        X, y = load_breast_cancer(return_X_y=True)
+    elif name == "digits":
+        from sklearn.datasets import load_digits
+        X, y = load_digits(return_X_y=True)
+    elif name == "wine":
+        from sklearn.datasets import load_wine
+        X, y = load_wine(return_X_y=True)
+    elif name in UCI_SHAPES:
+        X, y = _load_uci_or_synthetic(name, allow_synthetic)
+    else:
+        raise ValueError(f"Unknown dataset: {name}")
+
+    X = np.asarray(X, dtype=np.float32)
+    y = np.asarray(y, dtype=np.int64)
+    if normalize:
+        from sklearn.preprocessing import StandardScaler
+        X = StandardScaler().fit_transform(X).astype(np.float32)
+    return X, y
+
+
+def _load_uci_or_synthetic(name: str, allow_synthetic: bool):
+    n, d, c = UCI_SHAPES[name]
+    try:  # pragma: no cover - no egress in CI
+        import io
+        import urllib.request
+        urls = {
+            "spambase": "https://archive.ics.uci.edu/ml/machine-learning-databases/spambase/spambase.data",
+            "sonar": "https://archive.ics.uci.edu/ml/machine-learning-databases/undocumented/connectionist-bench/sonar/sonar.all-data",
+            "ionosphere": "https://archive.ics.uci.edu/ml/machine-learning-databases/ionosphere/ionosphere.data",
+            "banknote": "https://archive.ics.uci.edu/ml/machine-learning-databases/00267/data_banknote_authentication.txt",
+        }
+        if name not in urls:
+            raise OSError("no URL")
+        raw = urllib.request.urlopen(urls[name], timeout=10).read().decode()
+        rows = [r.split(",") for r in raw.strip().split("\n")]
+        X = np.array([[float(v) for v in r[:-1]] for r in rows], dtype=np.float32)
+        last = [r[-1].strip() for r in rows]
+        classes = {v: i for i, v in enumerate(sorted(set(last)))}
+        y = np.array([classes[v] for v in last], dtype=np.int64)
+        return X, y
+    except Exception:
+        if not allow_synthetic:
+            raise
+        warnings.warn(
+            f"Dataset '{name}' could not be downloaded (no egress?); using a "
+            f"deterministic synthetic stand-in of shape ({n}, {d}).")
+        return _synthetic_classification(name, n, d, c)
+
+
+def load_recsys_dataset(name: str = "ml-100k", allow_synthetic: bool = True):
+    """MovieLens ratings as {user: [(item, rating)]}, n_users, n_items.
+
+    The reference downloads MovieLens archives (data/__init__.py:628-681);
+    without egress a synthetic low-rank rating matrix with matching sparsity
+    is generated.
+    """
+    sizes = {"ml-100k": (943, 1682, 100_000), "ml-1m": (6040, 3706, 1_000_000)}
+    if name not in sizes:
+        raise ValueError(f"Unknown recsys dataset: {name}")
+    n_users, n_items, n_ratings = sizes[name]
+    if not allow_synthetic:
+        raise OSError("MovieLens download unavailable in this environment")
+    warnings.warn(f"RecSys dataset '{name}' substituted with a synthetic "
+                  "low-rank rating matrix (no egress).")
+    import zlib
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    k = 6
+    U = rng.normal(size=(n_users, k)) / np.sqrt(k)
+    V = rng.normal(size=(n_items, k)) / np.sqrt(k)
+    ratings: dict[int, list[tuple[int, float]]] = {}
+    per_user = max(n_ratings // n_users, 5)
+    for u in range(n_users):
+        items = rng.choice(n_items, size=min(per_user, n_items), replace=False)
+        raw = U[u] @ V[items].T
+        r = np.clip(np.round(3.0 + 1.5 * raw), 1, 5)
+        ratings[u] = [(int(i), float(v)) for i, v in zip(items, r)]
+    return ratings, n_users, n_items
+
+
+def _synthetic_images(name: str, n: int, shape: tuple, c: int):
+    """Class-dependent Gaussian-blob images, deterministic per name."""
+    import zlib
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    y = rng.integers(0, c, size=n).astype(np.int64)
+    X = rng.normal(0.0, 1.0, size=(n,) + shape).astype(np.float32)
+    h, w = shape[0], shape[1]
+    yy, xx = np.mgrid[0:h, 0:w]
+    for k in range(c):  # stamp a class-specific blob so the task is learnable
+        cy, cx = (k * 7) % h, (k * 11) % w
+        blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)).astype(np.float32)
+        X[y == k] += 2.5 * blob[..., None]
+    return X, y
+
+
+def get_CIFAR10(allow_synthetic: bool = True):
+    """CIFAR-10 train/test as NHWC float32 in [-1, 1]-ish range.
+
+    The reference uses torchvision downloads (data/__init__.py:684-726);
+    torchvision is absent here and there is no egress, so a deterministic
+    synthetic 32x32x3 10-class set of the same shape is substituted.
+    """
+    if not allow_synthetic:
+        raise OSError("CIFAR-10 download unavailable in this environment "
+                      "(torchvision missing / no egress)")
+    warnings.warn("CIFAR-10 substituted with synthetic 32x32x3 data (no egress).")
+    Xtr, ytr = _synthetic_images("cifar10-train", 50_000, (32, 32, 3), 10)
+    Xte, yte = _synthetic_images("cifar10-test", 10_000, (32, 32, 3), 10)
+    return (Xtr, ytr), (Xte, yte)
+
+
+def get_FashionMNIST(allow_synthetic: bool = True):
+    """FashionMNIST equivalent of :func:`get_CIFAR10` (reference :729-762)."""
+    if not allow_synthetic:
+        raise OSError("FashionMNIST download unavailable in this environment "
+                      "(torchvision missing / no egress)")
+    warnings.warn("FashionMNIST substituted with synthetic 28x28x1 data (no egress).")
+    Xtr, ytr = _synthetic_images("fmnist-train", 60_000, (28, 28, 1), 10)
+    Xte, yte = _synthetic_images("fmnist-test", 10_000, (28, 28, 1), 10)
+    return (Xtr, ytr), (Xte, yte)

@@ -1,0 +1,6 @@
+"""Simulation runtime (reference gossipy/simul.py re-designed for TPU)."""
+
+from .engine import GossipSimulator, Mailbox, SimState
+from .report import SimulationReport
+
+__all__ = ["GossipSimulator", "SimulationReport", "SimState", "Mailbox"]

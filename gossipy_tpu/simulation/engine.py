@@ -1,0 +1,532 @@
+"""The gossip simulation engine: one round = one jitted XLA program.
+
+Re-design of ``GossipSimulator`` (reference gossipy/simul.py:273-503). The
+reference steps Python time ``t`` over ``n_rounds * delta`` ticks, touching
+one node object at a time (simul.py:389-451). Here the WHOLE network state is
+a stacked pytree (leading node axis) and a round is a single traced function:
+
+    send phase     decide senders (phase arithmetic) -> sample peers
+                   (vectorized categorical over the adjacency) -> sample
+                   drop/delay -> scatter message *metadata* into a ring-buffer
+                   mailbox
+    deliver phase  read this round's mailbox cell; for each of K static slots
+                   gather the sender's snapshot from the params history ring
+                   and apply ``handler.call`` (merge+update) under a validity
+                   mask; queue replies (PULL/PUSH_PULL)
+    reply phase    same over the reply mailbox (reference keeps separate
+                   ``msg_queues``/``rep_queues``, simul.py:385-430)
+    eval phase     vmapped local + global evaluation, mean over nodes
+
+Key TPU-native choice: messages carry **node indices, not models**. The
+payload "deep copy" of the reference (``ModelHandler.caching`` ->
+``CACHE.push``, handler.py:160-176) becomes a per-round snapshot of the
+stacked params (``history[r % D]``); delivery is a gather along the node
+axis, which XLA turns into ICI collectives when the node axis is sharded.
+
+Fidelity notes (documented divergences, SURVEY.md §7c):
+
+- Bulk-synchronous rounds: within a round every send snapshots the
+  round-start model, while the reference's shuffled sequential loop lets a
+  node forward a model it merged moments earlier in the same round.
+- A node fires at most once per round (async nodes with period < round_len
+  would fire more often in the reference; periods are drawn ~N(delta,
+  delta/10), making that rare).
+- Replies carry the replier's round-start snapshot rather than its
+  just-updated model.
+- Mailboxes have a static per-round capacity of ``mailbox_slots`` messages
+  per receiver; overflow messages count as failed (the reference's Python
+  lists are unbounded).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import AntiEntropyProtocol, ConstantDelay, Delay, MessageType, Topology
+from ..handlers.base import BaseHandler, ModelState, PeerModel
+from .report import SimulationReport
+
+# Purpose tags for PRNG key folding (one stream per (round, purpose)).
+_K_PHASE, _K_PEER, _K_DROP, _K_DELAY, _K_ONLINE, _K_CALL, _K_EXTRA, \
+    _K_REPLY_DELAY, _K_REPLY_DROP, _K_EVAL, _K_TOKEN = range(11)
+
+
+class Mailbox(NamedTuple):
+    """Ring-buffer mailbox: [D, N, K] int32 metadata per message slot."""
+
+    sender: jax.Array      # sending node id, -1 = empty slot
+    send_round: jax.Array  # round whose snapshot carries the payload
+    msg_type: jax.Array    # MessageType value
+    extra: jax.Array       # protocol-specific payload (partition id, seed, ...)
+
+    @staticmethod
+    def empty(depth: int, n: int, k: int) -> "Mailbox":
+        shape = (depth, n, k)
+        return Mailbox(
+            sender=jnp.full(shape, -1, dtype=jnp.int32),
+            send_round=jnp.zeros(shape, dtype=jnp.int32),
+            msg_type=jnp.zeros(shape, dtype=jnp.int32),
+            extra=jnp.zeros(shape, dtype=jnp.int32),
+        )
+
+    def clear_cell(self, b: jax.Array) -> "Mailbox":
+        return Mailbox(
+            sender=self.sender.at[b].set(-1),
+            send_round=self.send_round.at[b].set(0),
+            msg_type=self.msg_type.at[b].set(0),
+            extra=self.extra.at[b].set(0),
+        )
+
+
+class SimState(NamedTuple):
+    """Full simulator state carried through the round scan."""
+
+    model: ModelState        # stacked [N, ...]
+    phase: jax.Array         # [N] per-node timing (offset or period)
+    history_params: Any      # pytree [D, N, ...] round-start snapshots
+    history_ages: jax.Array  # [D, N(, P)] snapshot ages
+    mailbox: Mailbox         # push/pull traffic
+    reply_box: Mailbox       # REPLY traffic (reference rep_queues)
+    round: jax.Array         # int32 current round
+
+
+def _rank_within_group(key_arr: jax.Array) -> jax.Array:
+    """For each element, its 0-based rank among equal values of ``key_arr``."""
+    n = key_arr.shape[0]
+    order = jnp.argsort(key_arr, stable=True)
+    sorted_key = key_arr[order]
+    pos = jnp.arange(n)
+    is_start = jnp.concatenate([jnp.ones(1, bool), sorted_key[1:] != sorted_key[:-1]])
+    group_start = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, pos, 0))
+    rank_sorted = pos - group_start
+    return jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+
+
+class GossipSimulator:
+    """Vanilla gossip simulator (reference GossipSimulator, simul.py:273-503).
+
+    Parameters
+    ----------
+    handler : BaseHandler
+        Model handler (closed over by the jitted round program).
+    topology : Topology
+        Static P2P network.
+    data : dict
+        Stacked arrays from ``DataDispatcher.stacked()``: ``xtr/ytr/mtr`` and
+        optionally ``xte/yte/mte`` and ``x_eval/y_eval``.
+    delta : int
+        Round length in time units (reference simul.py:300).
+    protocol : AntiEntropyProtocol
+    drop_prob, online_prob : float
+        Message loss / node availability Bernoulli rates (simul.py:403-428).
+    delay : Delay
+        Message latency model.
+    sampling_eval : float
+        If > 0, evaluate a random node subset each round (simul.py:433-436).
+    sync : bool
+        Sync nodes fire at a fixed offset each round; async nodes have a
+        ~N(delta, delta/10) period (reference node.py:79,111-125).
+    mailbox_slots, reply_slots : int
+        Static per-(round, receiver) message capacity.
+    message_size : int | None
+        Payload size in scalars for delay/size accounting; defaults to the
+        handler's model parameter count.
+    """
+
+    def __init__(self,
+                 handler: BaseHandler,
+                 topology: Topology,
+                 data: dict,
+                 delta: int = 100,
+                 protocol: AntiEntropyProtocol = AntiEntropyProtocol.PUSH,
+                 drop_prob: float = 0.0,
+                 online_prob: float = 1.0,
+                 delay: Delay = ConstantDelay(0),
+                 sampling_eval: float = 0.0,
+                 sync: bool = True,
+                 mailbox_slots: int = 4,
+                 reply_slots: int = 2,
+                 message_size: Optional[int] = None):
+        assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
+        self.handler = handler
+        self.topology = topology
+        self.n_nodes = topology.num_nodes
+        self.delta = int(delta)
+        self.protocol = protocol
+        self.drop_prob = float(drop_prob)
+        self.online_prob = float(online_prob)
+        self.delay = delay
+        self.sampling_eval = float(sampling_eval)
+        self.sync = sync
+        self.K = int(mailbox_slots)
+        self.Kr = int(reply_slots)
+
+        self.data = {k: jnp.asarray(v) for k, v in data.items()}
+        self.has_local_test = "xte" in data
+        self.has_global_eval = "x_eval" in data
+        self._message_size = message_size
+        self._metric_names: Optional[list[str]] = None
+        self._jit_cache: dict = {}
+
+    # -- setup -------------------------------------------------------------
+
+    def _local_data(self):
+        return (self.data["xtr"], self.data["ytr"], self.data["mtr"])
+
+    def _model_size(self, params) -> int:
+        if self._message_size is not None:
+            return self._message_size
+        if hasattr(self.handler, "get_size"):
+            return int(self.handler.get_size())
+        return sum(int(np.prod(l.shape[1:]))  # leading axis = node
+                   for l in jax.tree_util.tree_leaves(params))
+
+    def _history_depth(self) -> int:
+        """Ring depth: enough rounds to cover the worst-case in-flight delay."""
+        size = 1 if self._message_size is None else self._message_size
+        try:
+            max_d = self.delay.max_delay(size if size > 1 else 10 ** 6)
+        except Exception:
+            max_d = self.delta
+        # send offset <= delta-1, plus delay, plus one reply delay leg.
+        return max(2, (self.delta - 1 + 2 * max_d) // self.delta + 2)
+
+    def init_nodes(self, key: jax.Array, local_train: bool = True) -> SimState:
+        """Initialize every node's model (+ one local pre-training pass, the
+        reference's ``init_model`` behavior, node.py:82-94)."""
+        n = self.n_nodes
+        k_init, k_phase, k_up = jax.random.split(key, 3)
+        model = jax.vmap(self.handler.init)(jax.random.split(k_init, n))
+        if local_train:
+            model = jax.jit(jax.vmap(self.handler.update))(
+                model, self._local_data(), jax.random.split(k_up, n))
+        if self.sync:
+            phase = jax.random.randint(k_phase, (n,), 0, self.delta, dtype=jnp.int32)
+        else:
+            raw = self.delta + (self.delta / 10.0) * jax.random.normal(k_phase, (n,))
+            phase = jnp.maximum(raw.astype(jnp.int32), 1)
+
+        D = self._history_depth()
+        hist_p = jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (D,) + l.shape).copy(), model.params)
+        hist_a = jnp.broadcast_to(model.n_updates[None],
+                                  (D,) + model.n_updates.shape).copy()
+        return SimState(
+            model=model,
+            phase=phase,
+            history_params=hist_p,
+            history_ages=hist_a,
+            mailbox=Mailbox.empty(D, n, self.K),
+            reply_box=Mailbox.empty(D, n, self.Kr),
+            round=jnp.int32(0),
+        )
+
+    # -- per-round pieces ---------------------------------------------------
+
+    def _round_key(self, base_key: jax.Array, r: jax.Array, purpose: int):
+        return jax.random.fold_in(jax.random.fold_in(base_key, r), purpose)
+
+    def _fire_mask(self, state: SimState, r: jax.Array):
+        """Which nodes send this round + their time offset within the round.
+
+        Sync: every node fires once at its fixed offset (node.py:111-125).
+        Async: node fires iff a multiple of its period falls in this round's
+        [r*delta, (r+1)*delta) window.
+        """
+        if self.sync:
+            return jnp.ones(self.n_nodes, dtype=bool), state.phase
+        period = state.phase
+        lo = r * self.delta
+        hi = (r + 1) * self.delta
+        first = ((lo + period - 1) // period) * period  # first multiple >= lo
+        fires = first < hi
+        return fires, (first - lo).astype(jnp.int32)
+
+    def _scatter_messages(self, box: Mailbox, active, dr, recv, sender_ids,
+                          send_round, msg_type, extra, r, slots_cap):
+        """Allocate slots and scatter message metadata into ``box``.
+
+        Returns (box, n_overflow). Slot = existing occupancy of the target
+        cell + rank among this batch's messages for the same cell.
+        """
+        D = box.sender.shape[0]
+        n = box.sender.shape[1]
+        b = (r + dr) % D
+        cell_key = jnp.where(active, b * n + recv, jnp.int32(D * n + 7))
+        rank = _rank_within_group(cell_key)
+        occ = (box.sender >= 0).sum(axis=2)  # [D, N]
+        slot = occ[b, jnp.clip(recv, 0, n - 1)] + rank
+        ok = active & (slot < slots_cap)
+        n_overflow = (active & (slot >= slots_cap)).sum()
+        # Invalid writes get an out-of-range slot -> dropped by scatter mode.
+        slot = jnp.where(ok, slot, slots_cap)
+        recv_c = jnp.clip(recv, 0, n - 1)
+        box = Mailbox(
+            sender=box.sender.at[b, recv_c, slot].set(sender_ids, mode="drop"),
+            send_round=box.send_round.at[b, recv_c, slot].set(send_round, mode="drop"),
+            msg_type=box.msg_type.at[b, recv_c, slot].set(msg_type, mode="drop"),
+            extra=box.extra.at[b, recv_c, slot].set(extra, mode="drop"),
+        )
+        return box, n_overflow
+
+    def _send_extra(self, key: jax.Array, state: SimState) -> jax.Array:
+        """Protocol-specific int32 payload per sender (overridden by node
+        variants: partition ids, sample seeds, degrees...)."""
+        return jnp.zeros(self.n_nodes, dtype=jnp.int32)
+
+    def _send_phase(self, state: SimState, base_key, r):
+        n = self.n_nodes
+        fires, offset = self._fire_mask(state, r)
+        peers = self.topology.sample_peers(self._round_key(base_key, r, _K_PEER))
+        active = fires & (peers >= 0)
+
+        dropped = jax.random.bernoulli(
+            self._round_key(base_key, r, _K_DROP), self.drop_prob, (n,))
+        size = self._model_size(state.model.params)
+        if self.protocol == AntiEntropyProtocol.PULL:
+            size = 1  # PULL requests carry no model (core.py:163-164)
+        delays = self.delay.sample(self._round_key(base_key, r, _K_DELAY), (n,), size)
+        dr = (offset + delays) // self.delta
+
+        msg_type = {
+            AntiEntropyProtocol.PUSH: MessageType.PUSH,
+            AntiEntropyProtocol.PULL: MessageType.PULL,
+            AntiEntropyProtocol.PUSH_PULL: MessageType.PUSH_PULL,
+        }[self.protocol]
+        extra = self._send_extra(self._round_key(base_key, r, _K_EXTRA), state)
+
+        n_sent = active.sum()
+        n_fail_drop = (active & dropped).sum()
+        live = active & ~dropped
+        box, n_overflow = self._scatter_messages(
+            state.mailbox, live, dr, peers, jnp.arange(n, dtype=jnp.int32),
+            jnp.broadcast_to(r.astype(jnp.int32), (n,)),
+            jnp.full((n,), int(msg_type), dtype=jnp.int32),
+            extra, r, self.K)
+        sent_size = n_sent * size
+        return state._replace(mailbox=box), n_sent, n_fail_drop + n_overflow, sent_size
+
+    def _gather_peer(self, state: SimState, send_round, sender):
+        """Fetch the snapshot a message carries: history[send_round % D][sender]."""
+        D = state.history_ages.shape[0]
+        b = send_round % D
+        s = jnp.clip(sender, 0, self.n_nodes - 1)
+        params = jax.tree.map(lambda h: h[b, s], state.history_params)
+        ages = state.history_ages[b, s]
+        return PeerModel(params, ages)
+
+    def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
+                       call_key) -> SimState:
+        """Vmapped ``handler.call`` masked by ``valid`` (one mailbox slot)."""
+        data = self._local_data()
+        keys = jax.random.split(call_key, self.n_nodes)
+        extra_arg = self._decode_extra(extra)
+        new_model = jax.vmap(self.handler.call,
+                             in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
+                             )(state.model, peer, data, keys, extra_arg)
+        model = jax.tree.map(
+            lambda a, b: jnp.where(
+                valid.reshape((-1,) + (1,) * (a.ndim - 1)), a, b),
+            new_model, state.model)
+        return state._replace(model=model)
+
+    def _decode_extra(self, extra: jax.Array):
+        """Map the int32 wire field to the handler's ``extra`` argument.
+        Base protocol carries nothing."""
+        return None
+
+    def _deliver_phase(self, state: SimState, base_key, r):
+        n = self.n_nodes
+        D = state.history_ages.shape[0]
+        b = r % D
+        online = jax.random.bernoulli(
+            self._round_key(base_key, r, _K_ONLINE), self.online_prob, (n,))
+
+        n_failed = jnp.int32(0)
+        n_sent_replies = jnp.int32(0)
+        reply_size_total = jnp.int32(0)
+        size = self._model_size(state.model.params)
+
+        for k in range(self.K):
+            sender = state.mailbox.sender[b, :, k]
+            sr = state.mailbox.send_round[b, :, k]
+            ty = state.mailbox.msg_type[b, :, k]
+            extra = state.mailbox.extra[b, :, k]
+            occupied = sender >= 0
+            valid = occupied & online
+            n_failed += (occupied & ~online).sum()
+
+            carries_model = (ty == MessageType.PUSH) | \
+                            (ty == MessageType.PUSH_PULL) | \
+                            (ty == MessageType.REPLY)
+            peer = self._gather_peer(state, sr, sender)
+            state = self._apply_receive(
+                state, peer, extra, valid & carries_model,
+                self._round_key(base_key, r, _K_CALL * 101 + k))
+
+            if self._replies_possible():
+                wants_reply = (ty == MessageType.PULL) | (ty == MessageType.PUSH_PULL)
+                reply_needed = valid & wants_reply
+                rkey = self._round_key(base_key, r, _K_REPLY_DELAY * 101 + k)
+                rdrop = jax.random.bernoulli(
+                    self._round_key(base_key, r, _K_REPLY_DROP * 101 + k),
+                    self.drop_prob, (n,))
+                rdelay = self.delay.sample(rkey, (n,), size)
+                rdr = rdelay // self.delta
+                n_sent_replies += reply_needed.sum()
+                reply_size_total += reply_needed.sum() * size
+                n_failed += (reply_needed & rdrop).sum()
+                live = reply_needed & ~rdrop
+                rbox, n_overflow = self._scatter_messages(
+                    state.reply_box, live, rdr, sender,
+                    jnp.arange(n, dtype=jnp.int32),
+                    jnp.broadcast_to(r.astype(jnp.int32), (n,)),
+                    jnp.full((n,), int(MessageType.REPLY), dtype=jnp.int32),
+                    self._reply_extra(
+                        self._round_key(base_key, r, (_K_EXTRA + 31) * 101 + k),
+                        state), r, self.Kr)
+                n_failed += n_overflow
+                state = state._replace(reply_box=rbox)
+
+        state = state._replace(mailbox=state.mailbox.clear_cell(b))
+        return state, n_sent_replies, n_failed, reply_size_total
+
+    def _reply_extra(self, key: jax.Array, state: SimState) -> jax.Array:
+        return jnp.zeros(self.n_nodes, dtype=jnp.int32)
+
+    def _replies_possible(self) -> bool:
+        """Static: PUSH-only simulations never generate replies, so the whole
+        reply pipeline (Kr masked update passes per round) is elided at trace
+        time."""
+        return self.protocol != AntiEntropyProtocol.PUSH
+
+    def _reply_phase(self, state: SimState, base_key, r):
+        if not self._replies_possible():
+            return state, jnp.int32(0)
+        n = self.n_nodes
+        D = state.history_ages.shape[0]
+        b = r % D
+        online = jax.random.bernoulli(
+            self._round_key(base_key, r, _K_ONLINE * 7 + 3), self.online_prob, (n,))
+        n_failed = jnp.int32(0)
+        for k in range(self.Kr):
+            sender = state.reply_box.sender[b, :, k]
+            occupied = sender >= 0
+            valid = occupied & online
+            n_failed += (occupied & ~online).sum()
+            peer = self._gather_peer(state, state.reply_box.send_round[b, :, k], sender)
+            state = self._apply_receive(
+                state, peer, state.reply_box.extra[b, :, k], valid,
+                self._round_key(base_key, r, (_K_CALL + 53) * 101 + k))
+        state = state._replace(reply_box=state.reply_box.clear_cell(b))
+        return state, n_failed
+
+    # -- evaluation ---------------------------------------------------------
+
+    def _metric_keys(self) -> list[str]:
+        if self._metric_names is None:
+            if self.has_local_test:
+                d = (self.data["xte"][0], self.data["yte"][0], self.data["mte"][0])
+            else:
+                d = (self.data["xtr"][0], self.data["ytr"][0], self.data["mtr"][0])
+            st = self.handler.init(jax.random.PRNGKey(0))
+            self._metric_names = sorted(
+                jax.eval_shape(lambda s: self.handler.evaluate(s, d), st).keys())
+        return self._metric_names
+
+    def _eval_phase(self, state: SimState, base_key, r):
+        names = self._metric_keys()
+        nan = jnp.full((len(names),), jnp.nan, dtype=jnp.float32)
+        n = self.n_nodes
+
+        if self.sampling_eval > 0:
+            k_eval = self._round_key(base_key, r, _K_EVAL)
+            n_pick = max(int(n * self.sampling_eval), 1)
+            picked = jnp.zeros(n, bool).at[
+                jax.random.permutation(k_eval, n)[:n_pick]].set(True)
+        else:
+            picked = jnp.ones(n, dtype=bool)
+
+        def mean_metrics(res, node_mask):
+            vals = jnp.stack([res[k] for k in names], axis=-1)  # [N, M]
+            w = node_mask.astype(jnp.float32)
+            tot = w.sum()
+            return jnp.where(tot > 0,
+                             (vals * w[:, None]).sum(0) / jnp.maximum(tot, 1.0),
+                             nan)
+
+        local = nan
+        if self.has_local_test:
+            d = (self.data["xte"], self.data["yte"], self.data["mte"])
+            res = jax.vmap(self.handler.evaluate)(state.model, d)
+            has_test = self.data["mte"].sum(axis=1) > 0  # node.py:227-238
+            local = mean_metrics(res, picked & has_test)
+
+        glob = nan
+        if self.has_global_eval:
+            xe, ye = self.data["x_eval"], self.data["y_eval"]
+            me = jnp.ones(xe.shape[0], dtype=jnp.float32)
+            res = jax.vmap(lambda m: self.handler.evaluate(m, (xe, ye, me)))(state.model)
+            glob = mean_metrics(res, picked)
+        return local, glob
+
+    # -- the round program --------------------------------------------------
+
+    def _snapshot(self, state: SimState, r):
+        D = state.history_ages.shape[0]
+        b = r % D
+        hist_p = jax.tree.map(lambda h, p: h.at[b].set(p),
+                              state.history_params, state.model.params)
+        hist_a = state.history_ages.at[b].set(state.model.n_updates)
+        return state._replace(history_params=hist_p, history_ages=hist_a)
+
+    def _round(self, state: SimState, base_key: jax.Array):
+        r = state.round
+        state = self._snapshot(state, r)
+        state, n_sent, n_fail_s, size_s = self._send_phase(state, base_key, r)
+        state, n_replies, n_fail_d, size_r = self._deliver_phase(state, base_key, r)
+        state, n_fail_r = self._reply_phase(state, base_key, r)
+        local, glob = self._eval_phase(state, base_key, r)
+        state = state._replace(round=r + 1)
+        stats = {
+            "sent": n_sent + n_replies,
+            "failed": n_fail_s + n_fail_d + n_fail_r,
+            "size": size_s + size_r,
+            "local": local,
+            "global": glob,
+        }
+        return state, stats
+
+    # -- public API ---------------------------------------------------------
+
+    def start(self, state: SimState, n_rounds: int = 100,
+              key: Optional[jax.Array] = None) -> tuple[SimState, SimulationReport]:
+        """Run ``n_rounds`` rounds (reference simul.py:366-458) as one
+        ``lax.scan``; returns the final state and a report."""
+        if key is None:
+            key = jax.random.PRNGKey(42)
+
+        cache_k = ("start", n_rounds)
+        if cache_k not in self._jit_cache:
+            def run(state, key):
+                def body(st, _):
+                    return self._round(st, key)
+                return jax.lax.scan(body, state, None, length=n_rounds)
+            self._jit_cache[cache_k] = jax.jit(run)
+
+        state, stats = self._jit_cache[cache_k](state, key)
+        report = SimulationReport(
+            metric_names=self._metric_keys(),
+            local_evals=np.asarray(stats["local"]) if self.has_local_test else None,
+            global_evals=np.asarray(stats["global"]) if self.has_global_eval else None,
+            sent=np.asarray(stats["sent"]),
+            failed=np.asarray(stats["failed"]),
+            total_size=int(np.asarray(stats["size"]).sum()),
+        )
+        return state, report

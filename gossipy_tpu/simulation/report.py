@@ -1,0 +1,89 @@
+"""Simulation reporting: host-side view over the engine's traced accumulators.
+
+The reference uses an Observer pattern (``SimulationEventReceiver`` /
+``SimulationReport``, gossipy/simul.py:37-270) with per-message callbacks.
+A jitted engine cannot call back per message, so the engine emits per-round
+arrays (message counters, mean metrics) from the scan, and this module wraps
+them in an API-compatible report: ``get_evaluation(local)`` returns the
+``[(round, {metric: mean})]`` list the reference produces
+(simul.py:262-266).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+
+class SimulationReport:
+    """Results of a simulation run.
+
+    Parameters mirror what the engine's scan emits:
+
+    - ``metric_names``: static ordering of the metric dict keys
+    - ``local_evals`` / ``global_evals``: float arrays [R, M] of per-round
+      mean metric values (NaN where no eval ran)
+    - ``sent`` / ``failed``: int arrays [R] of messages generated / lost
+      (drop, churn, mailbox overflow) per round
+    - ``total_size``: cumulative message size in "atomic scalar" units, the
+      reference's ``Sizeable`` accounting (gossipy/__init__.py:134-156)
+    """
+
+    def __init__(self,
+                 metric_names: list[str],
+                 local_evals: Optional[np.ndarray],
+                 global_evals: Optional[np.ndarray],
+                 sent: np.ndarray,
+                 failed: np.ndarray,
+                 total_size: int):
+        self.metric_names = list(metric_names)
+        self._local = local_evals
+        self._global = global_evals
+        self.sent_messages = int(np.sum(sent))
+        self.failed_messages = int(np.sum(failed))
+        self.sent_per_round = np.asarray(sent)
+        self.failed_per_round = np.asarray(failed)
+        self.total_size = int(total_size)
+
+    def _to_rounds(self, arr: Optional[np.ndarray]):
+        if arr is None:
+            return []
+        out = []
+        for r in range(arr.shape[0]):
+            row = arr[r]
+            if np.all(np.isnan(row)):
+                continue
+            out.append((r + 1, {k: float(v) for k, v in zip(self.metric_names, row)}))
+        return out
+
+    def get_evaluation(self, local: bool = True):
+        """[(round, {metric: mean})] — API parity with reference simul.py:262-266."""
+        return self._to_rounds(self._local if local else self._global)
+
+    def curves(self, local: bool = True) -> dict[str, np.ndarray]:
+        """{metric: [R] array} convenience view for plotting/benchmarks."""
+        arr = self._local if local else self._global
+        if arr is None:
+            return {}
+        return {k: arr[:, i] for i, k in enumerate(self.metric_names)}
+
+    def final(self, metric: str, local: bool = False) -> float:
+        arr = self._local if local else self._global
+        if arr is None:
+            return float("nan")
+        col = arr[:, self.metric_names.index(metric)]
+        col = col[~np.isnan(col)]
+        return float(col[-1]) if len(col) else float("nan")
+
+    def __str__(self) -> str:
+        return json.dumps({
+            "sent_messages": self.sent_messages,
+            "failed_messages": self.failed_messages,
+            "total_size": self.total_size,
+            "rounds": 0 if self._local is None and self._global is None
+                      else int((self._local if self._local is not None
+                                else self._global).shape[0]),
+            "metrics": self.metric_names,
+        }, indent=2)

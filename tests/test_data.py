@@ -1,0 +1,177 @@
+"""Data layer tests: handlers, non-IID assigners, dispatcher stacking."""
+
+import numpy as np
+import pytest
+
+from gossipy_tpu.data import (
+    AssignmentHandler,
+    ClassificationDataHandler,
+    ClusteringDataHandler,
+    DataDispatcher,
+    RecSysDataDispatcher,
+    RecSysDataHandler,
+    load_classification_dataset,
+    load_recsys_dataset,
+)
+
+
+def make_labels(n=1000, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, c, size=n)
+
+
+class TestAssignments:
+    def _check_partition(self, parts, n_total, disjoint=True):
+        all_ids = np.concatenate([p for p in parts if len(p)])
+        if disjoint:
+            assert len(np.unique(all_ids)) == len(all_ids)
+        assert all_ids.max() < n_total
+
+    def test_uniform(self):
+        y = make_labels()
+        parts = AssignmentHandler(0).uniform(y, 10)
+        assert len(parts) == 10
+        assert all(len(p) == 100 for p in parts)
+        self._check_partition(parts, 1000)
+
+    def test_quantity_skew(self):
+        y = make_labels()
+        parts = AssignmentHandler(0).quantity_skew(y, 10, min_quantity=5, alpha=4.0)
+        sizes = np.array([len(p) for p in parts])
+        assert sizes.min() >= 5
+        assert sizes.sum() == 1000
+        # Power law: strong imbalance expected.
+        assert sizes.max() > 3 * sizes.min()
+        self._check_partition(parts, 1000)
+
+    def test_classwise_quantity_skew(self):
+        y = make_labels()
+        parts = AssignmentHandler(0).classwise_quantity_skew(y, 5, alpha=3.0)
+        assert sum(len(p) for p in parts) == 1000
+        self._check_partition(parts, 1000)
+
+    def test_label_quantity_skew(self):
+        y = make_labels(c=6)
+        parts = AssignmentHandler(0).label_quantity_skew(y, 8, class_per_client=2)
+        self._check_partition(parts, 1000)
+        for p in parts:
+            if len(p):
+                assert len(np.unique(y[p])) <= 2
+
+    def test_label_dirichlet_skew(self):
+        y = make_labels(c=4)
+        parts = AssignmentHandler(0).label_dirichlet_skew(y, 6, beta=0.1)
+        self._check_partition(parts, 1000)
+        # Every client holds >= 1 example of each class (the ids[:n] seeding).
+        for p in parts:
+            assert len(np.unique(y[p])) == 4
+
+    def test_label_pathological_skew(self):
+        y = make_labels(c=10)
+        parts = AssignmentHandler(0).label_pathological_skew(y, 10, shards_per_client=2)
+        assert sum(len(p) for p in parts) == 1000
+        self._check_partition(parts, 1000)
+        # Most clients see few classes.
+        n_classes = [len(np.unique(y[p])) for p in parts]
+        assert np.median(n_classes) <= 4
+
+
+class TestDispatcher:
+    def make_handler(self, n=200, d=5, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = rng.integers(0, 3, size=n)
+        return ClassificationDataHandler(X, y, test_size=0.2, seed=seed)
+
+    def test_handler_split(self):
+        h = self.make_handler()
+        assert h.size() == 160
+        assert h.eval_size() == 40
+        assert h.n_classes == 3
+        X, y = h.at([0, 1, 2])
+        assert X.shape == (3, 5)
+        assert h.at([], eval_set=True) is None
+
+    def test_getitem_api(self):
+        d = DataDispatcher(self.make_handler(), n=8)
+        train, test = d[0]
+        assert train[0].shape[0] == 160 // 8
+        assert test[0].shape[0] == 40 // 8
+        with pytest.raises(AssertionError):
+            d[8]
+
+    def test_stacked_shapes_and_masks(self):
+        d = DataDispatcher(self.make_handler(), n=8)
+        s = d.stacked()
+        assert s["xtr"].shape == (8, 20, 5)
+        assert s["mtr"].sum() == 160
+        assert s["xte"].shape[0] == 8
+        assert s["x_eval"].shape == (40, 5)
+
+    def test_stacked_uneven_shards_padded(self):
+        h = self.make_handler()
+        d = DataDispatcher(h, n=6, auto_assign=False,
+                           assignment=AssignmentHandler.quantity_skew,
+                           min_quantity=2, alpha=4.0)
+        d.assign(seed=1)
+        s = d.stacked()
+        sizes = np.array([len(a) for a in d.tr_assignments])
+        assert s["xtr"].shape[1] == sizes.max()
+        np.testing.assert_array_equal(s["mtr"].sum(axis=1), sizes)
+        # Padding rows are zero.
+        i = int(sizes.argmin())
+        assert (s["xtr"][i, sizes[i]:] == 0).all()
+
+    def test_stacked_pad_to_aligns_labels(self):
+        # Regression: ytr/mtr must share xtr's padded length under pad_to.
+        d = DataDispatcher(self.make_handler(), n=4)
+        s = d.stacked(pad_to=64)
+        assert s["xtr"].shape[:2] == s["ytr"].shape == s["mtr"].shape == (4, 64)
+
+    def test_eval_on_user_false(self):
+        d = DataDispatcher(self.make_handler(), n=4, eval_on_user=False)
+        s = d.stacked()
+        assert "xte" not in s
+        assert "x_eval" in s
+
+
+class TestLoaders:
+    def test_sklearn_datasets(self):
+        for name, c in [("iris", 3), ("breast", 2), ("wine", 3)]:
+            X, y = load_classification_dataset(name)
+            assert X.dtype == np.float32
+            assert len(np.unique(y)) == c
+            # normalized
+            assert abs(X.mean()) < 0.1
+
+    def test_uci_fallback_deterministic(self):
+        with pytest.warns(UserWarning):
+            X1, y1 = load_classification_dataset("spambase")
+        with pytest.warns(UserWarning):
+            X2, y2 = load_classification_dataset("spambase")
+        assert X1.shape == (4601, 57)
+        assert set(np.unique(y1)) == {0, 1}
+        np.testing.assert_array_equal(y1, y2)
+        np.testing.assert_allclose(X1, X2)
+
+    def test_recsys_loader_and_dispatcher(self):
+        with pytest.warns(UserWarning):
+            ratings, n_users, n_items = load_recsys_dataset("ml-100k")
+        assert n_users == 943
+        h = RecSysDataHandler(ratings, n_users, n_items, test_size=0.2, seed=1)
+        d = RecSysDataDispatcher(h)
+        s = d.stacked()
+        assert s["xtr"].shape[0] == 943
+        assert s["xtr"].dtype == np.int32
+        assert (s["ytr"][s["mtr"] > 0] >= 1).all()
+        train, test = d[0]
+        assert isinstance(train, list) and isinstance(test, list)
+
+    def test_clustering_handler(self):
+        X = np.random.default_rng(0).normal(size=(50, 3)).astype(np.float32)
+        y = np.zeros(50, dtype=int)
+        h = ClusteringDataHandler(X, y)
+        assert h.eval_size() == 50
+        Xe, ye = h.get_eval_set()
+        np.testing.assert_array_equal(Xe, h.Xtr)  # eval set IS the train set
+        assert Xe.shape == (50, 3)
