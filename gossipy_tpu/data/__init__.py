@@ -326,16 +326,21 @@ class RecSysDataDispatcher(DataDispatcher):
 # Dataset loaders (reference data/__init__.py:561-778)
 # ---------------------------------------------------------------------------
 
+def _name_seeded_rng(name: str) -> np.random.Generator:
+    """RNG deterministically keyed on a dataset name (crc32, not ``hash`` —
+    Python string hashing is salted per process)."""
+    import zlib
+    return np.random.default_rng(zlib.crc32(name.encode()))
+
+
 def _synthetic_classification(name: str, n: int, d: int, c: int,
                               seed: Optional[int] = None):
     """Deterministic synthetic stand-in for a non-downloadable dataset.
 
     A Gaussian-mixture classification problem keyed on the dataset name so
-    shapes and difficulty are stable across runs (crc32, not ``hash`` —
-    Python string hashing is salted per process).
+    shapes and difficulty are stable across runs.
     """
-    import zlib
-    rng = np.random.default_rng(zlib.crc32(name.encode()) if seed is None else seed)
+    rng = _name_seeded_rng(name) if seed is None else np.random.default_rng(seed)
     centers = rng.normal(scale=1.5, size=(c, d))
     per = n // c
     Xs, ys = [], []
@@ -429,8 +434,7 @@ def load_recsys_dataset(name: str = "ml-100k", allow_synthetic: bool = True):
         raise OSError("MovieLens download unavailable in this environment")
     warnings.warn(f"RecSys dataset '{name}' substituted with a synthetic "
                   "low-rank rating matrix (no egress).")
-    import zlib
-    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    rng = _name_seeded_rng(name)
     k = 6
     U = rng.normal(size=(n_users, k)) / np.sqrt(k)
     V = rng.normal(size=(n_items, k)) / np.sqrt(k)
@@ -446,8 +450,7 @@ def load_recsys_dataset(name: str = "ml-100k", allow_synthetic: bool = True):
 
 def _synthetic_images(name: str, n: int, shape: tuple, c: int):
     """Class-dependent Gaussian-blob images, deterministic per name."""
-    import zlib
-    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    rng = _name_seeded_rng(name)
     y = rng.integers(0, c, size=n).astype(np.int64)
     X = rng.normal(0.0, 1.0, size=(n,) + shape).astype(np.float32)
     h, w = shape[0], shape[1]

@@ -1,0 +1,91 @@
+"""Mesh construction and node-axis sharding.
+
+The reference has no distributed execution at all — its "network" is a Python
+loop (SURVEY.md §2.12). Here the *node* axis is a real device-mesh axis:
+every leading-``N`` array in :class:`~gossipy_tpu.simulation.SimState` and in
+the stacked data is sharded ``P("nodes")`` over ICI, so per-node local
+training runs data-parallel while peer-model gathers compile to XLA
+collectives (all-to-all / all-gather) over the mesh. Multi-host scales the
+same way: a 2-D ``(dcn, nodes)`` mesh makes XLA route the node axis over ICI
+within hosts and DCN across (jax.sharding semantics; cf. the public scaling
+book recipe: pick a mesh, annotate shardings, let XLA insert collectives).
+
+Model axes are left unsharded by default (gossip models are small); for a
+large model the ``PartitionSpec`` returned by :func:`state_shardings` can be
+extended with a ``model`` mesh axis on the parameter leaves (tensor
+parallelism) without touching the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..simulation.engine import Mailbox, SimState
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Mesh:
+    """A 1-D device mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        assert n_devices <= len(devs), \
+            f"requested {n_devices} devices, have {len(devs)}"
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def _spec_for_rank(lead_axis_pos: int, ndim: int, axis_name: str) -> P:
+    """PartitionSpec placing ``axis_name`` at position ``lead_axis_pos``."""
+    dims = [None] * ndim
+    dims[lead_axis_pos] = axis_name
+    return P(*dims)
+
+
+def state_shardings(state: SimState, mesh: Mesh,
+                    axis_name: str = NODE_AXIS) -> SimState:
+    """A SimState-shaped pytree of NamedShardings.
+
+    - model / phase leaves: node axis leading -> ``P("nodes", ...)``
+    - history / mailbox leaves: ``[D, N, ...]`` -> ``P(None, "nodes", ...)``
+    - scalars (round counter): replicated
+    """
+    def shard(leaf, pos):
+        if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, _spec_for_rank(pos, leaf.ndim, axis_name))
+
+    model_sh = jax.tree.map(lambda l: shard(l, 0), state.model)
+    phase_sh = shard(state.phase, 0)
+    hist_p_sh = jax.tree.map(lambda l: shard(l, 1), state.history_params)
+    hist_a_sh = shard(state.history_ages, 1)
+    mb_sh = jax.tree.map(lambda l: shard(l, 1), state.mailbox)
+    rb_sh = jax.tree.map(lambda l: shard(l, 1), state.reply_box)
+    return SimState(model=model_sh, phase=phase_sh,
+                    history_params=hist_p_sh, history_ages=hist_a_sh,
+                    mailbox=mb_sh, reply_box=rb_sh,
+                    round=NamedSharding(mesh, P()))
+
+
+def shard_state(state: SimState, mesh: Mesh,
+                axis_name: str = NODE_AXIS) -> SimState:
+    """Place a SimState onto the mesh, node axis sharded."""
+    return jax.device_put(state, state_shardings(state, mesh, axis_name))
+
+
+def shard_data(data: dict, mesh: Mesh, axis_name: str = NODE_AXIS) -> dict:
+    """Shard stacked data: per-node arrays over the node axis, the global
+    eval set replicated."""
+    out = {}
+    for k, v in data.items():
+        arr = jax.numpy.asarray(v)
+        if k in ("x_eval", "y_eval"):
+            out[k] = jax.device_put(arr, NamedSharding(mesh, P()))
+        else:
+            out[k] = jax.device_put(
+                arr, NamedSharding(mesh, _spec_for_rank(0, arr.ndim, axis_name)))
+    return out

@@ -187,13 +187,10 @@ class GossipSimulator:
         return sum(int(np.prod(l.shape[1:]))  # leading axis = node
                    for l in jax.tree_util.tree_leaves(params))
 
-    def _history_depth(self) -> int:
-        """Ring depth: enough rounds to cover the worst-case in-flight delay."""
-        size = 1 if self._message_size is None else self._message_size
-        try:
-            max_d = self.delay.max_delay(size if size > 1 else 10 ** 6)
-        except Exception:
-            max_d = self.delta
+    def _history_depth(self, size: int) -> int:
+        """Ring depth: enough rounds to cover the worst-case in-flight delay
+        for a message of ``size`` scalars."""
+        max_d = self.delay.max_delay(size)
         # send offset <= delta-1, plus delay, plus one reply delay leg.
         return max(2, (self.delta - 1 + 2 * max_d) // self.delta + 2)
 
@@ -212,7 +209,7 @@ class GossipSimulator:
             raw = self.delta + (self.delta / 10.0) * jax.random.normal(k_phase, (n,))
             phase = jnp.maximum(raw.astype(jnp.int32), 1)
 
-        D = self._history_depth()
+        D = self._history_depth(self._model_size(model.params))
         hist_p = jax.tree.map(
             lambda l: jnp.broadcast_to(l[None], (D,) + l.shape).copy(), model.params)
         hist_a = jnp.broadcast_to(model.n_updates[None],
@@ -237,7 +234,9 @@ class GossipSimulator:
 
         Sync: every node fires once at its fixed offset (node.py:111-125).
         Async: node fires iff a multiple of its period falls in this round's
-        [r*delta, (r+1)*delta) window.
+        [r*delta, (r+1)*delta) window. Note every async node fires at t=0 of
+        round 0 — faithful to the reference, whose time loop starts at t=0
+        (simul.py:384-389) where ``t % period == 0`` holds for all nodes.
         """
         if self.sync:
             return jnp.ones(self.n_nodes, dtype=bool), state.phase
@@ -445,16 +444,20 @@ class GossipSimulator:
         nan = jnp.full((len(names),), jnp.nan, dtype=jnp.float32)
         n = self.n_nodes
 
+        # With sampling_eval the node subset is GATHERED (static size n_pick),
+        # so only n_pick forward passes run — the point of the feature
+        # (reference simul.py:433-436).
         if self.sampling_eval > 0:
             k_eval = self._round_key(base_key, r, _K_EVAL)
             n_pick = max(int(n * self.sampling_eval), 1)
-            picked = jnp.zeros(n, bool).at[
-                jax.random.permutation(k_eval, n)[:n_pick]].set(True)
+            idx = jax.random.permutation(k_eval, n)[:n_pick]
+            model = jax.tree.map(lambda l: l[idx], state.model)
         else:
-            picked = jnp.ones(n, dtype=bool)
+            idx = jnp.arange(n)
+            model = state.model
 
         def mean_metrics(res, node_mask):
-            vals = jnp.stack([res[k] for k in names], axis=-1)  # [N, M]
+            vals = jnp.stack([res[k] for k in names], axis=-1)  # [n_pick, M]
             w = node_mask.astype(jnp.float32)
             tot = w.sum()
             return jnp.where(tot > 0,
@@ -463,17 +466,17 @@ class GossipSimulator:
 
         local = nan
         if self.has_local_test:
-            d = (self.data["xte"], self.data["yte"], self.data["mte"])
-            res = jax.vmap(self.handler.evaluate)(state.model, d)
-            has_test = self.data["mte"].sum(axis=1) > 0  # node.py:227-238
-            local = mean_metrics(res, picked & has_test)
+            d = (self.data["xte"][idx], self.data["yte"][idx], self.data["mte"][idx])
+            res = jax.vmap(self.handler.evaluate)(model, d)
+            has_test = self.data["mte"][idx].sum(axis=1) > 0  # node.py:227-238
+            local = mean_metrics(res, has_test)
 
         glob = nan
         if self.has_global_eval:
             xe, ye = self.data["x_eval"], self.data["y_eval"]
             me = jnp.ones(xe.shape[0], dtype=jnp.float32)
-            res = jax.vmap(lambda m: self.handler.evaluate(m, (xe, ye, me)))(state.model)
-            glob = mean_metrics(res, picked)
+            res = jax.vmap(lambda m: self.handler.evaluate(m, (xe, ye, me)))(model)
+            glob = mean_metrics(res, jnp.ones(idx.shape[0], dtype=bool))
         return local, glob
 
     # -- the round program --------------------------------------------------

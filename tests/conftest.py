@@ -1,21 +1,62 @@
 """Test configuration: run on CPU with 8 virtual devices.
 
-Multi-chip TPU hardware is not available in CI; sharded code paths (as they
-land) run on ``--xla_force_host_platform_device_count=8`` CPU devices — the
-same XLA partitioner and collectives as a real mesh.
+Multi-chip TPU hardware is not available in CI; sharded code paths run on
+``--xla_force_host_platform_device_count=8`` CPU devices — the same XLA
+partitioner and collectives as a real mesh.
+
+The environment may pre-initialize a TPU backend at interpreter startup via a
+sitecustomize hook on PYTHONPATH (so setting env vars here would be too
+late). In that case we re-exec pytest once with a cleaned environment. The
+re-exec happens in ``pytest_configure`` with global capture stopped so the
+child process writes to the real stdout/stderr.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+import pytest
 
-import jax  # noqa: E402  (import after env setup)
-import pytest  # noqa: E402
+_WANT_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("_GOSSIPY_TPU_TEST_REEXEC") == "1":
+        return False
+    return (os.environ.get("JAX_PLATFORMS") != "cpu"
+            or _WANT_FLAG not in os.environ.get("XLA_FLAGS", ""))
+
+
+_DO_REEXEC = _needs_reexec()
+
+if not _DO_REEXEC:
+    import jax
+
+    assert jax.default_backend() == "cpu", \
+        f"tests must run on CPU, got {jax.default_backend()}"
+
+
+def pytest_configure(config):
+    if not _DO_REEXEC:
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
+    env["_GOSSIPY_TPU_TEST_REEXEC"] = "1"
+    # Drop TPU-plugin sitecustomize entries (e.g. .axon_site) so the child
+    # interpreter starts clean on CPU.
+    path_entries = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                    if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(path_entries)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
 
 
 @pytest.fixture
 def key():
+    import jax
     return jax.random.PRNGKey(0)

@@ -144,7 +144,14 @@ class TestLoaders:
             # normalized
             assert abs(X.mean()) < 0.1
 
-    def test_uci_fallback_deterministic(self):
+    def test_uci_fallback_deterministic(self, monkeypatch):
+        # Force the no-download path so the test is environment-independent.
+        import urllib.request
+
+        def no_net(*a, **k):
+            raise OSError("no egress")
+
+        monkeypatch.setattr(urllib.request, "urlopen", no_net)
         with pytest.warns(UserWarning):
             X1, y1 = load_classification_dataset("spambase")
         with pytest.warns(UserWarning):

@@ -147,6 +147,14 @@ class TestProtocolsAndFaults:
         assert acc[-1] > 0.8
         assert report.failed_messages < report.sent_messages * 0.2
 
+    def test_linear_delay_history_ring_is_small(self, key):
+        # Regression: size-dependent delays must size the history ring from
+        # the REAL model size (10 scalars here), not a sentinel.
+        from gossipy_tpu.core import LinearDelay
+        sim = make_sim(delay=LinearDelay(0.1, 5), delta=20)
+        st = sim.init_nodes(key)
+        assert st.history_ages.shape[0] <= 4
+
     def test_sampling_eval(self, key):
         sim = make_sim(sampling_eval=0.25)
         st = sim.init_nodes(key)
