@@ -65,10 +65,12 @@ def state_shardings(state: SimState, mesh: Mesh,
     hist_a_sh = shard(state.history_ages, 1)
     mb_sh = jax.tree.map(lambda l: shard(l, 1), state.mailbox)
     rb_sh = jax.tree.map(lambda l: shard(l, 1), state.reply_box)
+    aux_sh = jax.tree.map(lambda l: shard(l, 0), state.aux)
     return SimState(model=model_sh, phase=phase_sh,
                     history_params=hist_p_sh, history_ages=hist_a_sh,
                     mailbox=mb_sh, reply_box=rb_sh,
-                    round=NamedSharding(mesh, P()))
+                    round=NamedSharding(mesh, P()),
+                    aux=aux_sh)
 
 
 def shard_state(state: SimState, mesh: Mesh,

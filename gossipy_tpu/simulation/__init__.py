@@ -1,6 +1,20 @@
 """Simulation runtime (reference gossipy/simul.py re-designed for TPU)."""
 
 from .engine import GossipSimulator, Mailbox, SimState
+from .nodes import (
+    CacheNeighGossipSimulator,
+    PartitioningGossipSimulator,
+    PassThroughGossipSimulator,
+    PENSGossipSimulator,
+    SamplingGossipSimulator,
+)
 from .report import SimulationReport
+from .variants import All2AllGossipSimulator, TokenizedGossipSimulator
 
-__all__ = ["GossipSimulator", "SimulationReport", "SimState", "Mailbox"]
+__all__ = [
+    "GossipSimulator", "SimulationReport", "SimState", "Mailbox",
+    "TokenizedGossipSimulator", "All2AllGossipSimulator",
+    "PassThroughGossipSimulator", "CacheNeighGossipSimulator",
+    "SamplingGossipSimulator", "PartitioningGossipSimulator",
+    "PENSGossipSimulator",
+]

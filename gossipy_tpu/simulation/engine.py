@@ -53,8 +53,26 @@ from ..handlers.base import BaseHandler, ModelState, PeerModel
 from .report import SimulationReport
 
 # Purpose tags for PRNG key folding (one stream per (round, purpose)).
+# Engine-internal derived tags stay below 9000; variant subclasses must use
+# tags >= 9000 to avoid stream collisions.
 _K_PHASE, _K_PEER, _K_DROP, _K_DELAY, _K_ONLINE, _K_CALL, _K_EXTRA, \
     _K_REPLY_DELAY, _K_REPLY_DROP, _K_EVAL, _K_TOKEN = range(11)
+
+PROTO_TO_MSG = {
+    AntiEntropyProtocol.PUSH: MessageType.PUSH,
+    AntiEntropyProtocol.PULL: MessageType.PULL,
+    AntiEntropyProtocol.PUSH_PULL: MessageType.PUSH_PULL,
+}
+
+
+def select_nodes(mask: jax.Array, a, b):
+    """Leafwise ``mask ? a : b`` where ``mask`` is a [N] node mask and the
+    leaves carry a leading node axis (scalar leaves pass through unmasked
+    broadcast)."""
+    def sel(x, y):
+        m = mask.reshape((-1,) + (1,) * (x.ndim - 1)) if x.ndim else mask
+        return jnp.where(m, x, y)
+    return jax.tree.map(sel, a, b)
 
 
 class Mailbox(NamedTuple):
@@ -94,6 +112,9 @@ class SimState(NamedTuple):
     mailbox: Mailbox         # push/pull traffic
     reply_box: Mailbox       # REPLY traffic (reference rep_queues)
     round: jax.Array         # int32 current round
+    aux: Any = ()            # variant-specific node state (token balances,
+                             # neighbor caches, PENS counters, ...) with
+                             # leading node axis on every leaf
 
 
 def _rank_within_group(key_arr: jax.Array) -> jax.Array:
@@ -222,7 +243,12 @@ class GossipSimulator:
             mailbox=Mailbox.empty(D, n, self.K),
             reply_box=Mailbox.empty(D, n, self.Kr),
             round=jnp.int32(0),
+            aux=self._init_aux(model, key),
         )
+
+    def _init_aux(self, model: ModelState, key: jax.Array):
+        """Variant-specific per-node state (token balances, caches, ...)."""
+        return ()
 
     # -- per-round pieces ---------------------------------------------------
 
@@ -279,11 +305,27 @@ class GossipSimulator:
         variants: partition ids, sample seeds, degrees...)."""
         return jnp.zeros(self.n_nodes, dtype=jnp.int32)
 
+    def _select_peers(self, state: SimState, base_key, r) -> jax.Array:
+        """One peer per node (overridden e.g. by PENS peer selection)."""
+        return self.topology.sample_peers(self._round_key(base_key, r, _K_PEER))
+
+    def _send_gate(self, state: SimState, active, peers, base_key, r):
+        """Hook gating sends (token-account flow control, PENS selection
+        bookkeeping). Returns the new active mask and (possibly updated)
+        state."""
+        return active, state
+
+    def _pre_send(self, state: SimState, base_key, r) -> SimState:
+        """Hook before the round snapshot (CacheNeigh merges its parked
+        neighbor model here so the outgoing snapshot includes it)."""
+        return state
+
     def _send_phase(self, state: SimState, base_key, r):
         n = self.n_nodes
         fires, offset = self._fire_mask(state, r)
-        peers = self.topology.sample_peers(self._round_key(base_key, r, _K_PEER))
+        peers = self._select_peers(state, base_key, r)
         active = fires & (peers >= 0)
+        active, state = self._send_gate(state, active, peers, base_key, r)
 
         dropped = jax.random.bernoulli(
             self._round_key(base_key, r, _K_DROP), self.drop_prob, (n,))
@@ -293,11 +335,7 @@ class GossipSimulator:
         delays = self.delay.sample(self._round_key(base_key, r, _K_DELAY), (n,), size)
         dr = (offset + delays) // self.delta
 
-        msg_type = {
-            AntiEntropyProtocol.PUSH: MessageType.PUSH,
-            AntiEntropyProtocol.PULL: MessageType.PULL,
-            AntiEntropyProtocol.PUSH_PULL: MessageType.PUSH_PULL,
-        }[self.protocol]
+        msg_type = PROTO_TO_MSG[self.protocol]
         extra = self._send_extra(self._round_key(base_key, r, _K_EXTRA), state)
 
         n_sent = active.sum()
@@ -329,11 +367,7 @@ class GossipSimulator:
         new_model = jax.vmap(self.handler.call,
                              in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
                              )(state.model, peer, data, keys, extra_arg)
-        model = jax.tree.map(
-            lambda a, b: jnp.where(
-                valid.reshape((-1,) + (1,) * (a.ndim - 1)), a, b),
-            new_model, state.model)
-        return state._replace(model=model)
+        return state._replace(model=select_nodes(valid, new_model, state.model))
 
     def _decode_extra(self, extra: jax.Array):
         """Map the int32 wire field to the handler's ``extra`` argument.
@@ -393,8 +427,23 @@ class GossipSimulator:
                 n_failed += n_overflow
                 state = state._replace(reply_box=rbox)
 
+            state = self._post_receive_slot(state, valid, ty, sender, extra,
+                                            base_key, r, k)
+
         state = state._replace(mailbox=state.mailbox.clear_cell(b))
-        return state, n_sent_replies, n_failed, reply_size_total
+        state, ex_sent, ex_failed, ex_size = self._post_deliver(state, base_key, r)
+        return state, n_sent_replies + ex_sent, n_failed + ex_failed, \
+            reply_size_total + ex_size
+
+    def _post_receive_slot(self, state: SimState, valid, ty, sender, extra,
+                           base_key, r, k) -> SimState:
+        """Hook after each mailbox slot is processed (token reactions...)."""
+        return state
+
+    def _post_deliver(self, state: SimState, base_key, r):
+        """Hook after the deliver phase; may emit extra messages. Returns
+        (state, n_sent, n_failed, total_size)."""
+        return state, jnp.int32(0), jnp.int32(0), jnp.int32(0)
 
     def _reply_extra(self, key: jax.Array, state: SimState) -> jax.Array:
         return jnp.zeros(self.n_nodes, dtype=jnp.int32)
@@ -491,6 +540,7 @@ class GossipSimulator:
 
     def _round(self, state: SimState, base_key: jax.Array):
         r = state.round
+        state = self._pre_send(state, base_key, r)
         state = self._snapshot(state, r)
         state, n_sent, n_fail_s, size_s = self._send_phase(state, base_key, r)
         state, n_replies, n_fail_d, size_r = self._deliver_phase(state, base_key, r)
@@ -508,6 +558,11 @@ class GossipSimulator:
 
     # -- public API ---------------------------------------------------------
 
+    def _cache_salt(self):
+        """Extra jit-cache key component for variants whose trace depends on
+        mutable static config (e.g. the PENS phase)."""
+        return 0
+
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None) -> tuple[SimState, SimulationReport]:
         """Run ``n_rounds`` rounds (reference simul.py:366-458) as one
@@ -515,7 +570,7 @@ class GossipSimulator:
         if key is None:
             key = jax.random.PRNGKey(42)
 
-        cache_k = ("start", n_rounds)
+        cache_k = ("start", n_rounds, self._cache_salt())
         if cache_k not in self._jit_cache:
             def run(state, key):
                 def body(st, _):

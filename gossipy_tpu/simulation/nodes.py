@@ -1,0 +1,386 @@
+"""Node-behavior simulator variants (reference gossipy/node.py:289-785).
+
+The reference specializes node *objects*; here each protocol variant is a
+``GossipSimulator`` subclass overriding the engine's trace-time hooks
+(payload generation, receive behavior, peer selection). All per-node variant
+state lives in ``state.aux`` (leading node axis), so everything stays inside
+the jitted round program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import CreateModelMode, MessageType
+from ..handlers.base import ModelState, PeerModel
+from .engine import GossipSimulator, SimState, select_nodes, _K_CALL, _K_PEER
+from .report import SimulationReport
+
+
+class PassThroughGossipSimulator(GossipSimulator):
+    """Giaretta 2019 pass-through nodes (reference node.py:289-392).
+
+    Messages carry the sender's degree; the receiver merge-updates with
+    probability ``min(1, deg_sender / deg_receiver)`` and otherwise adopts
+    the received model unmodified (PASS), hiding power-law degree bias.
+    """
+
+    def _send_extra(self, key, state):
+        return self.topology.degrees_dev.astype(jnp.int32)
+
+    def _reply_extra(self, key, state):
+        return self.topology.degrees_dev.astype(jnp.int32)
+
+    def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
+                       call_key) -> SimState:
+        deg_self = jnp.maximum(self.topology.degrees_dev.astype(jnp.float32), 1.0)
+        deg_send = extra.astype(jnp.float32)
+        p = jnp.minimum(1.0, deg_send / deg_self)
+        accept = jax.random.bernoulli(jax.random.fold_in(call_key, 911), p)
+
+        data = self._local_data()
+        keys = jax.random.split(call_key, self.n_nodes)
+        normal = jax.vmap(self.handler.call, in_axes=(0, 0, 0, 0, None))(
+            state.model, peer, data, keys, None)
+        # PASS: adopt the received model as-is (node.py:381-386).
+        passed = ModelState(peer.params, state.model.opt_state, peer.n_updates)
+        chosen = select_nodes(accept, normal, passed)
+        return state._replace(model=select_nodes(valid, chosen, state.model))
+
+
+class SamplingGossipSimulator(GossipSimulator):
+    """Hegedus 2021 sampled-merge exchange (reference node.py:499-562).
+
+    Each message carries a random sample seed; the receiver derives the
+    coordinate mask from it and performs a subset merge
+    (``SamplingSGDHandler``). The reference ships explicit index sets plus a
+    ``sample_size`` float; a PRNG seed is the constant-size equivalent.
+    """
+
+    _SAMPLE_KEY = 0x5A11
+
+    def _send_extra(self, key, state):
+        return jax.random.randint(key, (self.n_nodes,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+
+    def _reply_extra(self, key, state):
+        return jax.random.randint(key, (self.n_nodes,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+
+    def _decode_extra(self, extra):
+        base = jax.random.PRNGKey(self._SAMPLE_KEY)
+        return jax.vmap(lambda e: jax.random.fold_in(base, e))(extra)
+
+
+class PartitioningGossipSimulator(GossipSimulator):
+    """Hegedus 2021 partitioned exchange (reference node.py:566-659).
+
+    Every message (and reply) carries a uniformly random partition id; the
+    receiver merges only that partition (``PartitionedSGDHandler``).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert hasattr(self.handler, "partition"), \
+            "PartitioningGossipSimulator requires a PartitionedSGDHandler"
+        self.n_parts = self.handler.partition.n_parts
+
+    def _send_extra(self, key, state):
+        return jax.random.randint(key, (self.n_nodes,), 0, self.n_parts,
+                                  dtype=jnp.int32)
+
+    def _reply_extra(self, key, state):
+        return jax.random.randint(key, (self.n_nodes,), 0, self.n_parts,
+                                  dtype=jnp.int32)
+
+    def _decode_extra(self, extra):
+        return extra
+
+
+class CacheNeighGossipSimulator(GossipSimulator):
+    """Giaretta 2019 neighbor-cache nodes (reference node.py:395-496).
+
+    One model slot per neighbor: received models are parked (latest wins per
+    sender, node.py:480-485); at send time the node pops a RANDOM occupied
+    slot, merge-updates with it, then gossips its refreshed model
+    (node.py:446-452). The reference's ``random.choice(set(...))`` crash on
+    sets (node.py:449, latent bug) is fixed by construction.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # slot_of[i, j] = slot index of neighbor j at node i (-1 if none).
+        n = self.n_nodes
+        slot_of = np.full((n, n), -1, dtype=np.int32)
+        max_deg = int(self.topology.degrees.max()) if n else 0
+        for i in range(n):
+            for s, j in enumerate(np.where(self.topology.adjacency[i])[0]):
+                slot_of[i, j] = s
+        self.max_deg = max(max_deg, 1)
+        self.slot_of = jnp.asarray(slot_of)
+
+    def _init_aux(self, model: ModelState, key: jax.Array):
+        S = self.max_deg
+        cache_params = jax.tree.map(
+            lambda l: jnp.zeros((l.shape[0], S) + l.shape[1:], l.dtype),
+            model.params)
+        return {
+            "cache_params": cache_params,
+            "cache_age": jnp.zeros((self.n_nodes, S) + model.n_updates.shape[1:],
+                                   dtype=model.n_updates.dtype),
+            "cache_valid": jnp.zeros((self.n_nodes, S), dtype=bool),
+        }
+
+    def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
+                       call_key) -> SimState:
+        # Park the model in the sender's slot instead of merging (node.py:476-485).
+        sender_slot = extra  # we smuggle the sender id via extra; see below
+        slot = self.slot_of[jnp.arange(self.n_nodes), jnp.clip(sender_slot, 0,
+                                                               self.n_nodes - 1)]
+        ok = valid & (slot >= 0)
+        slot_c = jnp.clip(slot, 0, self.max_deg - 1)
+        idx = jnp.arange(self.n_nodes)
+
+        def park(cache, new):
+            upd = cache.at[idx, slot_c].set(new)
+            return jnp.where(ok.reshape((-1,) + (1,) * (cache.ndim - 1)),
+                             upd, cache)
+
+        aux = dict(state.aux)
+        aux["cache_params"] = jax.tree.map(park, state.aux["cache_params"],
+                                           peer.params)
+        aux["cache_age"] = park(state.aux["cache_age"], peer.n_updates)
+        aux["cache_valid"] = state.aux["cache_valid"].at[idx, slot_c].set(
+            jnp.where(ok, True, state.aux["cache_valid"][idx, slot_c]))
+        return state._replace(aux=aux)
+
+    def _send_extra(self, key, state):
+        # The engine stores the sender id in the mailbox already, but the
+        # receive hook only sees `extra`; mirror the sender id there.
+        return jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+    def _reply_extra(self, key, state):
+        return jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+    def _pre_send(self, state: SimState, base_key, r) -> SimState:
+        """At timeout: pop a random occupied cache slot and merge-update with
+        it before snapshotting/sending (node.py:446-452)."""
+        fires, _ = self._fire_mask(state, r)
+        valid = state.aux["cache_valid"]  # [N, S]
+        any_cached = valid.any(axis=1)
+        logits = jnp.where(valid, 0.0, -jnp.inf)
+        pick = jax.random.categorical(
+            self._round_key(base_key, r, _K_CALL + 77), logits, axis=-1)
+        pick_c = jnp.clip(pick, 0, self.max_deg - 1)
+        idx = jnp.arange(self.n_nodes)
+        cached = PeerModel(
+            jax.tree.map(lambda c: c[idx, pick_c], state.aux["cache_params"]),
+            state.aux["cache_age"][idx, pick_c])
+        do = fires & any_cached
+        keys = jax.random.split(self._round_key(base_key, r, _K_CALL + 78),
+                                self.n_nodes)
+        merged = jax.vmap(self.handler.call, in_axes=(0, 0, 0, 0, None))(
+            state.model, cached, self._local_data(), keys, None)
+        model = select_nodes(do, merged, state.model)
+        aux = dict(state.aux)
+        aux["cache_valid"] = valid.at[idx, pick_c].set(
+            jnp.where(do, False, valid[idx, pick_c]))
+        return state._replace(model=model, aux=aux)
+
+
+class PENSGossipSimulator(GossipSimulator):
+    """Onoszko 2021 PENS / DAC peer selection (reference node.py:663-785).
+
+    Phase 1 (first ``step1_rounds``): received models are scored by accuracy
+    on the receiver's LOCAL TRAIN data and buffered; once ``n_sampled``
+    models are buffered, the best ``m_top`` are merged (uniform average with
+    the local model) + trained, and their senders' counters increment.
+    Phase 2: a node gossips only with neighbors whose selection rate beats
+    ``m_top / n_sampled`` (node.py:726-749). PUSH only; handler mode must be
+    MERGE_UPDATE (node.py:713-714).
+
+    The phase switch is static, so :meth:`start` runs two scans (one per
+    phase) — each phase compiles to its own specialized program.
+    """
+
+    def __init__(self, *args, n_sampled: int = 10, m_top: int = 2,
+                 step1_rounds: int = 200, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert self.handler.mode == CreateModelMode.MERGE_UPDATE, \
+            "PENSNode can only be used with MERGE_UPDATE mode."  # node.py:713-714
+        self.n_sampled = int(n_sampled)
+        self.m_top = int(m_top)
+        self.step1_rounds = int(step1_rounds)
+        self._step = 1
+
+    def _init_aux(self, model: ModelState, key: jax.Array):
+        n, S = self.n_nodes, self.n_sampled
+        cache_params = jax.tree.map(
+            lambda l: jnp.zeros((l.shape[0], S) + l.shape[1:], l.dtype),
+            model.params)
+        return {
+            "selected": jnp.zeros((n, n), dtype=jnp.int32),
+            "neigh_counter": jnp.zeros((n, n), dtype=jnp.int32),
+            "cache_params": cache_params,
+            "cache_loss": jnp.full((n, S), jnp.inf, dtype=jnp.float32),
+            "cache_sender": jnp.full((n, S), -1, dtype=jnp.int32),
+            "cache_count": jnp.zeros((n,), dtype=jnp.int32),
+            "best": jnp.zeros((n, n), dtype=bool),
+        }
+
+    # -- peer selection -----------------------------------------------------
+
+    def _select_peers(self, state: SimState, base_key, r):
+        key = self._round_key(base_key, r, _K_PEER)
+        if self._step == 1:
+            return self.topology.sample_peers(key)
+        best = state.aux["best"]
+        has_best = best.any(axis=1)
+        logits_best = jnp.where(best, 0.0, -jnp.inf)
+        pick_best = jax.random.categorical(key, logits_best, axis=-1)
+        fallback = self.topology.sample_peers(jax.random.fold_in(key, 3))
+        return jnp.where(has_best, pick_best, fallback).astype(jnp.int32)
+
+    def _send_gate(self, state: SimState, active, peers, base_key, r):
+        if self._step == 1:
+            # selected[i, peer] += 1 at each step-1 pick (node.py:739-744).
+            idx = jnp.arange(self.n_nodes)
+            sel = state.aux["selected"].at[idx, jnp.clip(peers, 0, self.n_nodes - 1)
+                                           ].add(active.astype(jnp.int32))
+            aux = dict(state.aux)
+            aux["selected"] = sel
+            state = state._replace(aux=aux)
+        return active, state
+
+    # -- receive ------------------------------------------------------------
+
+    def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
+                       call_key) -> SimState:
+        if self._step == 2:
+            return super()._apply_receive(state, peer, extra, valid, call_key)
+
+        n, S = self.n_nodes, self.n_sampled
+        idx = jnp.arange(n)
+        data = self._local_data()
+        # Score the received model on local train data (node.py:775-777).
+        acc = jax.vmap(
+            lambda pm_params, d: self.handler.evaluate(
+                ModelState(pm_params, None, jnp.int32(0)), d)["accuracy"]
+        )(peer.params, data)
+        loss = -acc
+
+        aux = dict(state.aux)
+        count = aux["cache_count"]
+        sender_id = jnp.broadcast_to(extra, (n,))
+        # The reference keys its buffer by sender, latest model wins
+        # (node.py:777: ``self.cache[sender] = ...``): a repeat sender
+        # overwrites its slot instead of consuming a new one.
+        match = aux["cache_sender"] == sender_id[:, None]  # [N, S]
+        exists = match.any(axis=1)
+        pos = jnp.where(exists, jnp.argmax(match, axis=1),
+                        jnp.clip(count, 0, S - 1))
+        ok = valid & (exists | (count < S))
+
+        def put(cache, new):
+            upd = cache.at[idx, pos].set(new)
+            return jnp.where(ok.reshape((-1,) + (1,) * (cache.ndim - 1)),
+                             upd, cache)
+
+        aux["cache_params"] = jax.tree.map(put, aux["cache_params"], peer.params)
+        aux["cache_loss"] = put(aux["cache_loss"], loss)
+        aux["cache_sender"] = put(aux["cache_sender"], sender_id)
+        count = count + (ok & ~exists).astype(jnp.int32)
+
+        # Flush full buffers: merge the m_top best + train (node.py:778-783).
+        flush = count >= S
+        order = jnp.argsort(aux["cache_loss"], axis=1)  # best (lowest loss) first
+        top = order[:, : self.m_top]  # [N, m_top]
+
+        def avg_leaf(self_p, cache_p):
+            picked = jnp.take_along_axis(
+                cache_p, top.reshape((n, self.m_top) + (1,) * (cache_p.ndim - 2)),
+                axis=1)
+            return (self_p + picked.sum(axis=1)) / (self.m_top + 1.0)
+
+        merged_params = jax.tree.map(avg_leaf, state.model.params,
+                                     aux["cache_params"])
+        merged = ModelState(merged_params, state.model.opt_state,
+                            state.model.n_updates)
+        keys = jax.random.split(call_key, n)
+        trained = jax.vmap(self.handler.update)(merged, data, keys)
+        model = select_nodes(flush, trained, state.model)
+
+        top_senders = jnp.take_along_axis(aux["cache_sender"], top, axis=1)
+        inc = jnp.zeros((n, n), dtype=jnp.int32)
+        rows = jnp.repeat(idx[:, None], self.m_top, axis=1)
+        inc = inc.at[rows, jnp.clip(top_senders, 0, n - 1)].add(
+            (flush[:, None] & (top_senders >= 0)).astype(jnp.int32))
+        aux["neigh_counter"] = aux["neigh_counter"] + inc
+
+        aux["cache_count"] = jnp.where(flush, 0, count)
+        aux["cache_loss"] = jnp.where(flush[:, None], jnp.inf, aux["cache_loss"])
+        aux["cache_sender"] = jnp.where(flush[:, None], -1, aux["cache_sender"])
+        return state._replace(model=model, aux=aux)
+
+    def _send_extra(self, key, state):
+        # Receive hooks need the sender id as a payload field.
+        return jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+    def _decode_extra(self, extra):
+        return None if self._step == 2 else extra
+
+    def _cache_salt(self):
+        return self._step
+
+    # -- phase-segmented run -------------------------------------------------
+
+    def _select_neighbors(self, state: SimState) -> SimState:
+        """Phase transition (node.py:728-733): best_j iff counter beats the
+        base selection rate."""
+        thresh = self.m_top / self.n_sampled
+        best = state.aux["neigh_counter"].astype(jnp.float32) > \
+            state.aux["selected"].astype(jnp.float32) * thresh
+        aux = dict(state.aux)
+        aux["best"] = best
+        return state._replace(aux=aux)
+
+    def start(self, state: SimState, n_rounds: int = 100,
+              key: Optional[jax.Array] = None):
+        if key is None:
+            key = jax.random.PRNGKey(42)
+        # The phase split follows GLOBAL simulation time (node.py:732-736:
+        # ``t // round_len >= step1_rounds``), so continuing a run from a
+        # carried state resumes in the right phase.
+        round0 = int(np.asarray(state.round))
+        r1 = max(0, min(self.step1_rounds - round0, n_rounds))
+        reports = []
+        if r1 > 0:
+            self._step = 1
+            state, rep1 = super().start(state, n_rounds=r1, key=key)
+            reports.append(rep1)
+        if n_rounds - r1 > 0:
+            state = self._select_neighbors(state)
+            self._step = 2
+            state, rep2 = super().start(state, n_rounds=n_rounds - r1,
+                                        key=jax.random.fold_in(key, 2))
+            reports.append(rep2)
+        if len(reports) == 1:
+            return state, reports[0]
+        merged = SimulationReport(
+            metric_names=reports[0].metric_names,
+            local_evals=_cat([r._local for r in reports]),
+            global_evals=_cat([r._global for r in reports]),
+            sent=np.concatenate([r.sent_per_round for r in reports]),
+            failed=np.concatenate([r.failed_per_round for r in reports]),
+            total_size=sum(r.total_size for r in reports),
+        )
+        return state, merged
+
+
+def _cat(arrs):
+    arrs = [a for a in arrs if a is not None]
+    return np.concatenate(arrs) if arrs else None

@@ -1,0 +1,229 @@
+"""Simulator variants: token-account flow control and all-to-all mixing.
+
+Re-designs of ``TokenizedGossipSimulator`` (reference simul.py:506-689) and
+``All2AllGossipSimulator`` + ``All2AllGossipNode`` (simul.py:720-852,
+node.py:789-870).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import AntiEntropyProtocol, CreateModelMode, MessageType
+from ..flow_control import TokenAccount
+from ..handlers.base import ModelState, PeerModel
+from .engine import GossipSimulator, PROTO_TO_MSG, SimState, select_nodes
+
+# Variant PRNG purpose tags (>= 9000; engine-internal tags stay below).
+_K_REACT_GATE = 9000       # proactive send gate
+_K_REACT_SLOT = 9100       # + slot k: reactive randomized rounding
+_K_REACT_PEER = 9200       # + 10*j: reaction wave peer choice
+_K_REACT_DROP = 9201       # + 10*j
+_K_REACT_DELAY = 9202      # + 10*j
+_K_REACT_EXTRA = 9203      # + 10*j
+_K_A2A_DROP = 9400
+_K_A2A_ONLINE = 9401
+_K_A2A_UPDATE = 9402
+
+
+class TokenizedGossipSimulator(GossipSimulator):
+    """Gossip with Danner-2018 token-account flow control.
+
+    Per-node integer token balances live in ``state.aux``:
+
+    - At timeout, a node sends with probability ``account.proactive(balance)``;
+      otherwise it banks a token (reference simul.py:602-615).
+    - On receiving a message that needs no reply, the receiver computes the
+      message utility and performs ``account.reactive(balance, utility)``
+      extra sends, debiting its balance (simul.py:631-648). Extra sends are
+      capped at ``max_reactions`` per node per round (static shapes;
+      SURVEY.md §7(e)) and delivered from the next round onwards.
+
+    Intentional divergence: the reference's reactive block reuses a stale
+    loop variable so reactions are emitted by the wrong node (simul.py:640,
+    ``node`` is whatever the send loop last touched); here reactions
+    correctly originate from the receiver.
+
+    ``utility_fun(receiver_model: ModelState, sender_snapshot: PeerModel) ->
+    [N] array`` replaces the reference's per-message callable; the repro
+    config uses a constant 1 (main_hegedus_2021.py:59).
+    """
+
+    def __init__(self, *args, token_account: TokenAccount,
+                 utility_fun: Optional[Callable] = None,
+                 max_reactions: int = 3, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.account = token_account
+        self.utility_fun = utility_fun or (
+            lambda recv_model, sender_snap: jnp.ones(self.n_nodes, jnp.float32))
+        self.max_reactions = int(max_reactions)
+
+    def _init_aux(self, model: ModelState, key: jax.Array):
+        return {"balance": self.account.init_balance(self.n_nodes),
+                "pending_reactions": jnp.zeros(self.n_nodes, dtype=jnp.int32)}
+
+    def _send_gate(self, state: SimState, active, peers, base_key, r):
+        balance = state.aux["balance"]
+        p = self.account.proactive(balance)
+        gate = jax.random.bernoulli(
+            self._round_key(base_key, r, _K_REACT_GATE), jnp.clip(p, 0.0, 1.0))
+        send = active & gate
+        # Nodes that timed out but were gated bank one token (simul.py:613-615).
+        balance = balance + (active & ~gate).astype(jnp.int32)
+        aux = dict(state.aux)
+        aux["balance"] = balance
+        return send, state._replace(aux=aux)
+
+    def _post_receive_slot(self, state: SimState, valid, ty, sender, extra,
+                           base_key, r, k) -> SimState:
+        # Reactions fire for messages that produce no reply (simul.py:636-639).
+        no_reply = ~((ty == MessageType.PULL) | (ty == MessageType.PUSH_PULL))
+        trigger = valid & no_reply
+        # Sender snapshot for the utility: this round's history cell (the
+        # round-start model). Reference utility functions in the shipped
+        # experiments are constant (main_hegedus_2021.py:59).
+        peer = self._gather_peer(
+            state, jnp.broadcast_to(r.astype(jnp.int32), sender.shape), sender)
+        utility = self.utility_fun(state.model, peer)
+        balance = state.aux["balance"]
+        reaction = self.account.reactive(
+            balance, jnp.where(trigger, utility, 0.0),
+            self._round_key(base_key, r, _K_REACT_SLOT + k))
+        reaction = jnp.where(trigger, reaction, 0)
+        balance = jnp.maximum(balance - reaction, 0)  # flow_control.py:43-52
+        aux = dict(state.aux)
+        aux["balance"] = balance
+        aux["pending_reactions"] = jnp.clip(
+            state.aux["pending_reactions"] + reaction, 0, self.max_reactions)
+        return state._replace(aux=aux)
+
+    def _post_deliver(self, state: SimState, base_key, r):
+        n = self.n_nodes
+        size = self._model_size(state.model.params)
+        pending = state.aux["pending_reactions"]
+        n_sent = jnp.int32(0)
+        n_failed = jnp.int32(0)
+        total_size = jnp.int32(0)
+        msg_type = PROTO_TO_MSG[self.protocol]
+        for j in range(self.max_reactions):
+            fire = pending > j
+            kj = self._round_key(base_key, r, _K_REACT_PEER + 10 * j)
+            peers = self.topology.sample_peers(kj)
+            active = fire & (peers >= 0)
+            dropped = jax.random.bernoulli(
+                self._round_key(base_key, r, _K_REACT_DROP + 10 * j),
+                self.drop_prob, (n,))
+            delays = self.delay.sample(
+                self._round_key(base_key, r, _K_REACT_DELAY + 10 * j), (n,), size)
+            # Reaction messages are emitted mid-round; same-round delivery is
+            # not possible once the mailbox cell was drained, so the earliest
+            # delivery is next round (documented divergence).
+            dr = jnp.maximum(delays // self.delta, 1)
+            n_sent += active.sum()
+            total_size += active.sum() * size
+            n_failed += (active & dropped).sum()
+            live = active & ~dropped
+            box, n_overflow = self._scatter_messages(
+                state.mailbox, live, dr, peers, jnp.arange(n, dtype=jnp.int32),
+                jnp.broadcast_to(r.astype(jnp.int32), (n,)),
+                jnp.full((n,), int(msg_type), dtype=jnp.int32),
+                self._send_extra(self._round_key(base_key, r, _K_REACT_EXTRA + 10 * j), state), r, self.K)
+            n_failed += n_overflow
+            state = state._replace(mailbox=box)
+        aux = dict(state.aux)
+        aux["pending_reactions"] = jnp.zeros_like(pending)
+        return state._replace(aux=aux), n_sent, n_failed, total_size
+
+
+class All2AllGossipSimulator(GossipSimulator):
+    """Koloskova-style decentralized SGD: broadcast + weighted mixing.
+
+    Reference behavior (simul.py:720-852 + node.py:789-870): every timed-out
+    node PUSHes to ALL peers; receivers park models; at its own timeout a
+    node merges its cache with mixing weights (``WeightedTMH``) and trains.
+
+    TPU-native formulation: with round-start params stacked as ``P [N, ...]``
+    and the effective (drop/churn-masked, row-renormalized) mixing matrix
+    ``W_eff [N, N]``, the entire network's merge is ONE einsum
+    ``P' = W_eff @ P`` — dense MXU work instead of N^2 Python receives —
+    followed by the vmapped local update.
+
+    Documented divergences: lost messages' mixing weight is redistributed by
+    row renormalization (the reference silently shrinks the average,
+    node.py:841 with missing cache entries); message delays collapse to
+    round granularity (a round's mix uses round-start snapshots).
+    """
+
+    def __init__(self, *args, mixing: jax.Array, **kwargs):
+        kwargs.setdefault("protocol", AntiEntropyProtocol.PUSH)
+        super().__init__(*args, **kwargs)
+        assert self.protocol == AntiEntropyProtocol.PUSH, \
+            "All2AllNode only supports PUSH protocol."  # node.py:856-858
+        self.mixing = jnp.asarray(mixing, dtype=jnp.float32)
+
+    def _round(self, state: SimState, base_key: jax.Array):
+        r = state.round
+        state = self._snapshot(state, r)
+        n = self.n_nodes
+        fires, _ = self._fire_mask(state, r)
+
+        # Per-edge liveness: sender fired, message not dropped, receiver online.
+        drop = jax.random.bernoulli(
+            self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob, (n, n))
+        online = jax.random.bernoulli(
+            self._round_key(base_key, r, _K_A2A_ONLINE), self.online_prob, (n,))
+        adj = self.topology.adjacency_dev
+        live = adj & fires[None, :] & ~drop & online[:, None]  # [recv, sender]
+
+        w = self.mixing * live
+        w = w + jnp.diag(jnp.diag(self.mixing))  # self weight always present
+        row_sum = w.sum(axis=1, keepdims=True)
+        w_eff = w / jnp.maximum(row_sum, 1e-12)
+
+        n_sent = (adj & fires[None, :]).sum()
+        n_failed = (adj & fires[None, :] & (drop | ~online[:, None])).sum()
+        size = self._model_size(state.model.params)
+
+        # The mixing merge: one matmul per parameter leaf.
+        def mix_leaf(p):
+            flat = p.reshape(n, -1)
+            return (w_eff @ flat).reshape(p.shape)
+
+        received_any = (live & (self.mixing > 0)).any(axis=1)
+        mode = self.handler.mode
+        if mode == CreateModelMode.UPDATE_MERGE:
+            keys = jax.random.split(self._round_key(base_key, r, _K_A2A_UPDATE), n)
+            updated = jax.vmap(self.handler.update)(
+                state.model, self._local_data(), keys)
+            model = updated
+            mixed = jax.tree.map(mix_leaf, model.params)
+        else:  # MERGE_UPDATE (the reference's supported path, handler.py:652-654)
+            mixed = jax.tree.map(mix_leaf, state.model.params)
+            model = state.model
+        ages = jnp.where(live, model.n_updates[None, :], 0).max(axis=1)
+        new_age = jnp.maximum(model.n_updates, ages)
+        params = select_nodes(received_any, mixed, model.params)
+        model = ModelState(params, model.opt_state,
+                           jnp.where(received_any, new_age, model.n_updates))
+
+        if mode != CreateModelMode.UPDATE_MERGE:
+            keys = jax.random.split(self._round_key(base_key, r, _K_A2A_UPDATE), n)
+            updated = jax.vmap(self.handler.update)(model, self._local_data(), keys)
+            # Only nodes that fired (timed out) train this round (node.py:833-843).
+            model = select_nodes(fires, updated, model)
+
+        state = state._replace(model=model)
+        local, glob = self._eval_phase(state, base_key, r)
+        state = state._replace(round=r + 1)
+        stats = {
+            "sent": n_sent,
+            "failed": n_failed,
+            "size": n_sent * size,
+            "local": local,
+            "global": glob,
+        }
+        return state, stats
