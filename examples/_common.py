@@ -1,0 +1,54 @@
+"""Shared CLI plumbing for the reproduction scripts.
+
+Each ``examples/main_*.py`` re-creates one of the reference's experiment
+scripts (reference repo root, SURVEY.md §2.11) on the gossipy_tpu engine.
+All scripts accept ``--rounds`` / ``--nodes`` overrides so the same configs
+double as quick smoke runs, and ``--plot PATH`` to save the reference-style
+mean curves (reference utils.py:152-183).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Make the scripts runnable from a source checkout without installation.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def make_parser(description: str, rounds: int, nodes: int | None = None,
+                with_plot: bool = True):
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--rounds", type=int, default=rounds,
+                   help=f"simulation rounds (reference config: {rounds})")
+    if nodes is not None:
+        p.add_argument("--nodes", type=int, default=nodes,
+                       help=f"number of gossip nodes (reference config: {nodes})")
+    if with_plot:
+        p.add_argument("--plot", type=str, default=None,
+                       help="save metric curves to this path (PNG)")
+    p.add_argument("--seed", type=int, default=42)
+    return p
+
+
+def finish(report, args, local: bool = False, label: str = "final"):
+    """Print a one-line JSON summary + optionally save the plot."""
+    evals = report.get_evaluation(local)
+    summary = {
+        "rounds": len(evals),
+        "sent_messages": report.sent_messages,
+        "failed_messages": report.failed_messages,
+        "total_size": report.total_size,
+    }
+    if evals:
+        summary[label] = {k: round(v, 4) for k, v in evals[-1][1].items()}
+    print(json.dumps(summary))
+    if args.plot:
+        from gossipy_tpu.utils import plot_evaluation
+        plot_evaluation([[ev for _, ev in evals]],
+                        title=sys.argv[0], path=args.plot)
+    return summary

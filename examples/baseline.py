@@ -1,0 +1,80 @@
+"""Centralized baselines: what accuracy/AUC a single global model reaches.
+
+Re-design of reference ``baseline.py:10-92``: a centralized MLP trained on
+the full (undistributed) training set — once with our jitted flax/optax
+training path and once with sklearn's ``MLPClassifier`` — giving the quality
+anchor gossip runs are compared against. The reference's feature-map test
+split (``te_fmap``) is specific to an unshipped handler variant and is
+omitted; overall test accuracy/AUC are reported.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+import optax
+
+from _common import make_parser
+
+from gossipy_tpu import set_seed
+from gossipy_tpu.data import ClassificationDataHandler, load_classification_dataset
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import MLP
+
+
+def flax_mlp(data_handler, n_epochs: int = 300, batch_size: int = 16,
+             learning_rate: float = 0.01, l2_reg: float = 0.001,
+             seed: int = 42) -> dict:
+    """Centralized MLP via the same handler machinery the gossip nodes use."""
+    handler = SGDHandler(
+        model=MLP(data_handler.size(1), 2, hidden_dims=(100,)),
+        loss=losses.cross_entropy,
+        optimizer=optax.chain(optax.add_decayed_weights(l2_reg),
+                              optax.sgd(learning_rate)),
+        local_epochs=n_epochs, batch_size=batch_size, n_classes=2,
+        input_shape=(data_handler.size(1),))
+    key = jax.random.PRNGKey(seed)
+    state = handler.init(key)
+    Xtr, ytr = data_handler.get_train_set()
+    mask = np.ones(len(Xtr), dtype=np.float32)
+    state = jax.jit(handler.update)(state, (Xtr, ytr, mask), key)
+    Xte, yte = data_handler.get_eval_set()
+    res = handler.evaluate(state, (np.asarray(Xte), np.asarray(yte),
+                                   np.ones(len(Xte), dtype=np.float32)))
+    return {k: float(v) for k, v in res.items()}
+
+
+def sklearn_mlp(data_handler, n_epochs: int = 300, batch_size: int = 16,
+                learning_rate: float = 0.01, l2_reg: float = 0.001) -> dict:
+    from sklearn.metrics import accuracy_score, roc_auc_score
+    from sklearn.neural_network import MLPClassifier
+    Xtr, ytr = data_handler.get_train_set()
+    Xte, yte = data_handler.get_eval_set()
+    clf = MLPClassifier(max_iter=n_epochs, learning_rate_init=learning_rate,
+                        alpha=l2_reg, batch_size=batch_size,
+                        verbose=False).fit(Xtr, np.asarray(ytr).ravel())
+    return {
+        "accuracy": float(accuracy_score(yte, clf.predict(Xte))),
+        "auc": float(roc_auc_score(yte, clf.predict_proba(Xte)[:, 1])),
+    }
+
+
+def main():
+    parser = make_parser(__doc__, rounds=300, with_plot=False)  # no curves here
+    parser.add_argument("--dataset", default="spambase")
+    args = parser.parse_args()
+    set_seed(args.seed)
+
+    X, y = load_classification_dataset(args.dataset)
+    data_handler = ClassificationDataHandler(X, y, test_size=0.1, seed=args.seed)
+
+    print(json.dumps({
+        "flax_mlp": flax_mlp(data_handler, n_epochs=args.rounds, seed=args.seed),
+        "sklearn_mlp": sklearn_mlp(data_handler, n_epochs=args.rounds),
+    }))
+
+
+if __name__ == "__main__":
+    main()

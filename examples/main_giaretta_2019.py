@@ -1,0 +1,68 @@
+"""Giaretta & Girdzijauskas 2019 — gossip learning on a power-law topology.
+
+Reproduction of reference ``main_giaretta_2019.py:23-53``: spambase with ±1
+labels, one node per sample, Pegasos under MERGE_UPDATE, Barabási–Albert
+(m=10) topology, async PUSH, 10% sampled evaluation. (The PassThrough /
+CacheNeigh node behaviors from the same paper are available as
+``PassThroughGossipSimulator`` / ``CacheNeighGossipSimulator``; use
+``--variant`` to select one.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from _common import make_parser, finish
+
+from gossipy_tpu import set_seed
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher, \
+    load_classification_dataset
+from gossipy_tpu.handlers import PegasosHandler
+from gossipy_tpu.models import AdaLine
+from gossipy_tpu.simulation import (
+    CacheNeighGossipSimulator,
+    GossipSimulator,
+    PassThroughGossipSimulator,
+)
+
+SIMULATORS = {
+    "vanilla": GossipSimulator,
+    "passthrough": PassThroughGossipSimulator,
+    "cacheneigh": CacheNeighGossipSimulator,
+}
+
+
+def main():
+    parser = make_parser(__doc__, rounds=100, nodes=0)
+    parser.add_argument("--variant", choices=sorted(SIMULATORS), default="vanilla",
+                        help="node behavior (reference node.py:289-496)")
+    args = parser.parse_args()
+    key = set_seed(args.seed)
+
+    X, y = load_classification_dataset("spambase")
+    y = (2 * y - 1).astype(np.float32)
+
+    data_handler = ClassificationDataHandler(X, y, test_size=0.1, seed=args.seed)
+    n = args.nodes or data_handler.size()
+    dispatcher = DataDispatcher(data_handler, n=n, eval_on_user=False)
+
+    handler = PegasosHandler(net=AdaLine(data_handler.size(1)),
+                             learning_rate=0.01,
+                             create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+    simulator = SIMULATORS[args.variant](
+        handler, Topology.barabasi_albert(n, m=min(10, n - 1), seed=args.seed),
+        dispatcher.stacked(),
+        delta=100,
+        protocol=AntiEntropyProtocol.PUSH,
+        sampling_eval=0.1,
+        sync=False)
+
+    state = simulator.init_nodes(key)
+    state, report = simulator.start(state, n_rounds=args.rounds, key=key)
+    finish(report, args, local=False)
+
+
+if __name__ == "__main__":
+    main()

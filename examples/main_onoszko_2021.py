@@ -1,0 +1,88 @@
+"""Onoszko et al. 2021 — PENS decentralized peer selection on CIFAR-10.
+
+Reproduction of reference ``main_onoszko_2021.py:28-124``: CIFAR-10 where the
+second half of the images is vertically flipped (two-cluster non-IID), the
+5-layer ``CIFAR10Net`` CNN (SGD, lr 0.01, weight decay 1e-3, batch 8, 3 local
+epochs, MERGE_UPDATE), 5 PENS nodes with contiguous data assignment over a
+clique, async PUSH, ``n_sampled=10, m_top=2, step1_rounds=100``, 10% sampled
+evaluation, 500 rounds.
+
+CIFAR-10 itself cannot be downloaded in this environment; ``get_CIFAR10``
+substitutes a deterministic synthetic set of the same shape (see
+gossipy_tpu/data). ``--subsample`` caps per-split sizes for smoke runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+
+from _common import make_parser, finish
+
+from gossipy_tpu import set_seed
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher, get_CIFAR10
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import CIFAR10Net
+from gossipy_tpu.simulation import PENSGossipSimulator
+
+
+def contiguous_assignment(n_samples: int, n_nodes: int) -> list[np.ndarray]:
+    """The reference's CustomDataDispatcher: contiguous equal blocks
+    (main_onoszko_2021.py:59-75)."""
+    per = -(-n_samples // n_nodes)  # ceil
+    return [np.arange(i * per, min((i + 1) * per, n_samples))
+            for i in range(n_nodes)]
+
+
+def main():
+    parser = make_parser(__doc__, rounds=500, nodes=5)
+    parser.add_argument("--subsample", type=int, default=0,
+                        help="cap train/test sizes (0 = full)")
+    parser.add_argument("--step1-rounds", type=int, default=100)
+    args = parser.parse_args()
+    key = set_seed(args.seed)
+
+    (Xtr, ytr), (Xte, yte) = get_CIFAR10()
+    if args.subsample:
+        Xtr, ytr = Xtr[: args.subsample], ytr[: args.subsample]
+        Xte, yte = Xte[: args.subsample // 5 or 1], yte[: args.subsample // 5 or 1]
+    # Normalize to [-1, 1]-style range and flip the second half vertically
+    # (reference: Normalize(0.5, 0.5) + RandomVerticalFlip(p=1) on half).
+    Xtr = (Xtr - Xtr.mean()) / (Xtr.std() + 1e-8)
+    Xte = (Xte - Xte.mean()) / (Xte.std() + 1e-8)
+    half, half_te = len(Xtr) // 2, len(Xte) // 2
+    Xtr[half:] = Xtr[half:, ::-1, :, :]
+    Xte[half_te:] = Xte[half_te:, ::-1, :, :]
+
+    data_handler = ClassificationDataHandler(Xtr, ytr, Xte, yte)
+    n = args.nodes
+    dispatcher = DataDispatcher(data_handler, n=n, eval_on_user=False,
+                                auto_assign=False)
+    dispatcher.set_assignments(contiguous_assignment(len(Xtr), n))
+
+    handler = SGDHandler(
+        model=CIFAR10Net(),
+        loss=losses.cross_entropy,
+        optimizer=optax.chain(optax.add_decayed_weights(1e-3), optax.sgd(0.01)),
+        local_epochs=3, batch_size=8, n_classes=10, input_shape=Xtr.shape[1:],
+        create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+    # Documented divergence: the reference passes n_sampled=10 with 5 clique
+    # nodes, but its phase-1 buffer is keyed by sender (node.py:777) so it can
+    # hold at most n-1 entries and `len(cache) >= 10` never fires — the PENS
+    # selection in the shipped config is inert. Capping at n-1 makes the
+    # mechanism actually run, as the paper intends.
+    simulator = PENSGossipSimulator(
+        handler, Topology.clique(n), dispatcher.stacked(),
+        n_sampled=min(10, n - 1), m_top=2, step1_rounds=args.step1_rounds,
+        delta=100, protocol=AntiEntropyProtocol.PUSH,
+        sampling_eval=0.1, sync=False)
+
+    state = simulator.init_nodes(key)
+    state, report = simulator.start(state, n_rounds=args.rounds, key=key)
+    finish(report, args, local=False)
+
+
+if __name__ == "__main__":
+    main()

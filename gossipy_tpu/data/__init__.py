@@ -40,7 +40,7 @@ __all__ = [
     "ClassificationDataHandler", "ClusteringDataHandler",
     "RegressionDataHandler", "RecSysDataHandler", "DataHandler",
     "load_classification_dataset", "load_recsys_dataset",
-    "get_CIFAR10", "get_FashionMNIST",
+    "get_CIFAR10", "get_FashionMNIST", "get_FEMNIST",
 ]
 
 # UCI datasets the reference downloads (data/__init__.py:45-52): name ->
@@ -487,3 +487,41 @@ def get_FashionMNIST(allow_synthetic: bool = True):
     Xtr, ytr = _synthetic_images("fmnist-train", 60_000, (28, 28, 1), 10)
     Xte, yte = _synthetic_images("fmnist-test", 10_000, (28, 28, 1), 10)
     return (Xtr, ytr), (Xte, yte)
+
+
+def get_FEMNIST(n_writers: int = 100, allow_synthetic: bool = True):
+    """Federated EMNIST: per-writer shards of 28x28 character images.
+
+    Mirrors reference ``get_FEMNIST`` (data/__init__.py:765-778), which
+    downloads a per-writer tar and returns ``(X, y, assignment)`` per split,
+    where ``assignment[i]`` is writer ``i``'s index list. The reference's
+    loop never advances its ``sum_tr``/``sum_te`` cursors so every writer is
+    assigned the FIRST writer's rows (the ``sum_tr = sum_te = 0`` bug); here
+    the cursors advance — an intentional, documented fix.
+
+    No egress: a deterministic synthetic per-writer dataset is substituted
+    (62 classes as in EMNIST-byclass; writer shard sizes vary log-normally
+    like real handwriting corpora).
+    """
+    if not allow_synthetic:
+        raise OSError("FEMNIST download unavailable in this environment "
+                      "(no egress)")
+    warnings.warn("FEMNIST substituted with synthetic per-writer 28x28 data "
+                  "(no egress).")
+    rng = _name_seeded_rng("femnist")
+    n_classes = 62
+    sizes_tr = np.maximum((rng.lognormal(4.5, 0.4, n_writers)).astype(int), 8)
+    sizes_te = np.maximum(sizes_tr // 5, 2)
+
+    def build(sizes, tag):
+        X, y = _synthetic_images(f"femnist-{tag}", int(sizes.sum()),
+                                 (28, 28, 1), n_classes)
+        assignment, cursor = [], 0
+        for s in sizes:
+            assignment.append(np.arange(cursor, cursor + int(s)))
+            cursor += int(s)
+        return X, y, assignment
+
+    Xtr, ytr, tr_assignment = build(sizes_tr, "train")
+    Xte, yte, te_assignment = build(sizes_te, "test")
+    return (Xtr, ytr, tr_assignment), (Xte, yte, te_assignment)

@@ -1,6 +1,11 @@
 """Simulation runtime (reference gossipy/simul.py re-designed for TPU)."""
 
 from .engine import GossipSimulator, Mailbox, SimState
+from .events import (
+    ProgressReceiver,
+    SimulationEventReceiver,
+    SimulationEventSender,
+)
 from .nodes import (
     CacheNeighGossipSimulator,
     PartitioningGossipSimulator,
@@ -9,12 +14,18 @@ from .nodes import (
     SamplingGossipSimulator,
 )
 from .report import SimulationReport
-from .variants import All2AllGossipSimulator, TokenizedGossipSimulator
+from .variants import (
+    All2AllGossipSimulator,
+    TokenizedGossipSimulator,
+    TokenizedPartitioningGossipSimulator,
+)
 
 __all__ = [
     "GossipSimulator", "SimulationReport", "SimState", "Mailbox",
     "TokenizedGossipSimulator", "All2AllGossipSimulator",
+    "TokenizedPartitioningGossipSimulator",
     "PassThroughGossipSimulator", "CacheNeighGossipSimulator",
     "SamplingGossipSimulator", "PartitioningGossipSimulator",
     "PENSGossipSimulator",
+    "SimulationEventReceiver", "SimulationEventSender", "ProgressReceiver",
 ]

@@ -50,6 +50,7 @@ import numpy as np
 
 from ..core import AntiEntropyProtocol, ConstantDelay, Delay, MessageType, Topology
 from ..handlers.base import BaseHandler, ModelState, PeerModel
+from .events import SimulationEventSender
 from .report import SimulationReport
 
 # Purpose tags for PRNG key folding (one stream per (round, purpose)).
@@ -129,7 +130,7 @@ def _rank_within_group(key_arr: jax.Array) -> jax.Array:
     return jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
 
 
-class GossipSimulator:
+class GossipSimulator(SimulationEventSender):
     """Vanilla gossip simulator (reference GossipSimulator, simul.py:273-503).
 
     Parameters
@@ -558,27 +559,61 @@ class GossipSimulator:
 
     # -- public API ---------------------------------------------------------
 
+    def _emit_live(self, state: SimState, stats: dict) -> None:
+        """Ordered host callback notifying live receivers at a round boundary
+        (the only point a jitted run touches the host; SURVEY §5)."""
+        names = self._metric_keys()
+
+        def cb(rnd, sent, failed, size, local, glob):
+            def row(vals):
+                if np.all(np.isnan(vals)):
+                    return None
+                return {k: float(v) for k, v in zip(names, vals)}
+            self._notify_round(int(rnd), int(sent), int(failed), int(size),
+                               row(local), row(glob), live_only=True)
+
+        jax.experimental.io_callback(
+            cb, None, state.round, stats["sent"], stats["failed"],
+            stats["size"], stats["local"], stats["global"], ordered=True)
+
     def _cache_salt(self):
         """Extra jit-cache key component for variants whose trace depends on
         mutable static config (e.g. the PENS phase)."""
         return 0
 
     def start(self, state: SimState, n_rounds: int = 100,
-              key: Optional[jax.Array] = None) -> tuple[SimState, SimulationReport]:
+              key: Optional[jax.Array] = None,
+              profile_dir: Optional[str] = None) -> tuple[SimState, SimulationReport]:
         """Run ``n_rounds`` rounds (reference simul.py:366-458) as one
-        ``lax.scan``; returns the final state and a report."""
+        ``lax.scan``; returns the final state and a report.
+
+        ``profile_dir`` wraps the run in a ``jax.profiler`` trace (SURVEY §5:
+        the reference has no tracing; per-round hooks attach via the event
+        stream, see :mod:`gossipy_tpu.simulation.events`).
+        """
         if key is None:
             key = jax.random.PRNGKey(42)
 
-        cache_k = ("start", n_rounds, self._cache_salt())
+        live = self.has_live_receivers()
+        first_round = int(np.asarray(state.round))
+        cache_k = ("start", n_rounds, self._cache_salt(), live)
         if cache_k not in self._jit_cache:
             def run(state, key):
                 def body(st, _):
-                    return self._round(st, key)
+                    st, stats = self._round(st, key)
+                    if live:
+                        self._emit_live(st, stats)
+                    return st, stats
                 return jax.lax.scan(body, state, None, length=n_rounds)
             self._jit_cache[cache_k] = jax.jit(run)
 
-        state, stats = self._jit_cache[cache_k](state, key)
+        if profile_dir is not None:
+            with jax.profiler.trace(profile_dir):
+                state, stats = self._jit_cache[cache_k](state, key)
+                jax.block_until_ready(state.model.params)
+        else:
+            state, stats = self._jit_cache[cache_k](state, key)
+        self.replay_events(first_round, stats, self._metric_keys())
         report = SimulationReport(
             metric_names=self._metric_keys(),
             local_evals=np.asarray(stats["local"]) if self.has_local_test else None,

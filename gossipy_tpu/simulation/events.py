@@ -1,0 +1,148 @@
+"""Observer event stream for simulation runs.
+
+Re-design of the reference's Observer pattern (``SimulationEventReceiver`` /
+``SimulationEventSender``, gossipy/simul.py:37-177). Two deliberate changes:
+
+- **Granularity is per round, not per message.** The reference fires
+  ``update_message`` for every Python ``Message`` object; a jitted round has
+  no per-message host boundary, so receivers get per-round aggregates
+  (messages sent / failed / bytes this round) — the quantities the
+  reference's own ``SimulationReport`` reduces to anyway (simul.py:216-234).
+- **Senders own their receiver list.** The reference keeps ``_receivers`` as
+  a CLASS attribute shared by every sender instance (simul.py:94, a latent
+  cross-simulator leak); here each simulator instance has its own list.
+
+Two delivery modes (both can be active):
+
+- *replay* (default): after the jitted scan finishes, the recorded per-round
+  arrays are replayed through every receiver in order. Zero overhead inside
+  the compiled program.
+- *live*: when a receiver declares ``live = True``, the engine inserts an
+  ordered ``io_callback`` at each round boundary so the receiver observes
+  rounds as they execute (progress bars, early-stopping monitors, tracing).
+  This forces a host sync per round — opt in deliberately.
+
+``jax.profiler`` integration (SURVEY.md §5 "tracing"): pass
+``profile_dir=...`` to ``GossipSimulator.start`` to wrap the run in a
+profiler trace viewable in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SimulationEventReceiver:
+    """Receiver interface (reference simul.py:37-88, per-round granularity).
+
+    Subclass and override any subset; set class attribute ``live = True`` to
+    be notified from inside the running program (ordered io_callback) instead
+    of replay-after-run.
+    """
+
+    live: bool = False
+
+    def update_message(self, round: int, sent: int, failed: int,
+                       size: int) -> None:
+        """Per-round message traffic: ``sent`` messages generated, ``failed``
+        lost (drop / churn / overflow), ``size`` total scalars shipped."""
+
+    def update_evaluation(self, round: int, on_user: bool,
+                          metrics: dict[str, float]) -> None:
+        """Mean metrics for this round (``on_user`` = local test sets)."""
+
+    def update_timestep(self, round: int) -> None:
+        """A round finished (the reference's per-``t`` tick, simul.py:161-171)."""
+
+    def update_end(self) -> None:
+        """The run finished."""
+
+
+class SimulationEventSender:
+    """Mixin managing per-INSTANCE receivers (cf. reference simul.py:91-177)."""
+
+    def add_receiver(self, receiver: SimulationEventReceiver) -> None:
+        self._receivers_list().append(receiver)
+
+    def remove_receiver(self, receiver: SimulationEventReceiver) -> None:
+        try:
+            self._receivers_list().remove(receiver)
+        except ValueError:
+            pass
+
+    def _receivers_list(self) -> list[SimulationEventReceiver]:
+        if not hasattr(self, "_receivers"):
+            self._receivers: list[SimulationEventReceiver] = []
+        return self._receivers
+
+    def has_live_receivers(self) -> bool:
+        return any(r.live for r in self._receivers_list())
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _notify_round(self, round: int, sent: int, failed: int, size: int,
+                      local: Optional[dict], glob: Optional[dict],
+                      live_only: bool = False) -> None:
+        for r in self._receivers_list():
+            if live_only and not r.live:
+                continue
+            if not live_only and r.live:
+                continue  # live receivers already saw this round in-run
+            r.update_message(round, sent, failed, size)
+            if local is not None:
+                r.update_evaluation(round, True, local)
+            if glob is not None:
+                r.update_evaluation(round, False, glob)
+            r.update_timestep(round)
+
+    def _notify_end(self) -> None:
+        for r in self._receivers_list():
+            r.update_end()
+
+    def replay_events(self, first_round: int, stats: dict,
+                      metric_names: list[str]) -> None:
+        """Replay recorded per-round stats (host arrays) through non-live
+        receivers, then fire ``update_end``."""
+        if not self._receivers_list():
+            return
+        sent = np.asarray(stats["sent"])
+        failed = np.asarray(stats["failed"])
+        size = np.asarray(stats["size"])
+        local = np.asarray(stats["local"])
+        glob = np.asarray(stats["global"])
+
+        def row(arr, i):
+            vals = arr[i]
+            if np.all(np.isnan(vals)):
+                return None
+            return {k: float(v) for k, v in zip(metric_names, vals)}
+
+        for i in range(sent.shape[0]):
+            self._notify_round(first_round + i + 1, int(sent[i]),
+                               int(failed[i]), int(size[i]),
+                               row(local, i), row(glob, i))
+        self._notify_end()
+
+
+class ProgressReceiver(SimulationEventReceiver):
+    """Live round-progress printer (replaces the reference's rich progress
+    bars around the time loop, simul.py:384)."""
+
+    live = True
+
+    def __init__(self, every: int = 10, metric: str = "accuracy"):
+        self.every = int(every)
+        self.metric = metric
+        self._last: dict[str, float] = {}
+
+    def update_evaluation(self, round, on_user, metrics):
+        if not on_user:
+            self._last = metrics
+
+    def update_timestep(self, round):
+        if round % self.every == 0:
+            val = self._last.get(self.metric)
+            extra = f" {self.metric}={val:.4f}" if val is not None else ""
+            print(f"[round {round}]{extra}", flush=True)

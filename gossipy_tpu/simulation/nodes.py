@@ -17,8 +17,12 @@ import numpy as np
 
 from ..core import CreateModelMode, MessageType
 from ..handlers.base import ModelState, PeerModel
-from .engine import GossipSimulator, SimState, select_nodes, _K_CALL, _K_PEER
+from .engine import GossipSimulator, SimState, select_nodes, _K_PEER
 from .report import SimulationReport
+
+# Variant PRNG purpose tags (>= 9000 per the engine's stream-tag contract).
+_K_CACHE_POP = 9500    # CacheNeigh: which parked slot to pop
+_K_CACHE_MERGE = 9501  # CacheNeigh: merge-update randomness
 
 
 class PassThroughGossipSimulator(GossipSimulator):
@@ -174,14 +178,14 @@ class CacheNeighGossipSimulator(GossipSimulator):
         any_cached = valid.any(axis=1)
         logits = jnp.where(valid, 0.0, -jnp.inf)
         pick = jax.random.categorical(
-            self._round_key(base_key, r, _K_CALL + 77), logits, axis=-1)
+            self._round_key(base_key, r, _K_CACHE_POP), logits, axis=-1)
         pick_c = jnp.clip(pick, 0, self.max_deg - 1)
         idx = jnp.arange(self.n_nodes)
         cached = PeerModel(
             jax.tree.map(lambda c: c[idx, pick_c], state.aux["cache_params"]),
             state.aux["cache_age"][idx, pick_c])
         do = fires & any_cached
-        keys = jax.random.split(self._round_key(base_key, r, _K_CALL + 78),
+        keys = jax.random.split(self._round_key(base_key, r, _K_CACHE_MERGE),
                                 self.n_nodes)
         merged = jax.vmap(self.handler.call, in_axes=(0, 0, 0, 0, None))(
             state.model, cached, self._local_data(), keys, None)
@@ -212,6 +216,15 @@ class PENSGossipSimulator(GossipSimulator):
         super().__init__(*args, **kwargs)
         assert self.handler.mode == CreateModelMode.MERGE_UPDATE, \
             "PENSNode can only be used with MERGE_UPDATE mode."  # node.py:713-714
+        max_senders = int(self.topology.degrees.max()) if self.n_nodes else 0
+        if n_sampled > max_senders:
+            import warnings
+            warnings.warn(
+                f"PENS n_sampled={n_sampled} exceeds the max in-degree "
+                f"({max_senders}): the sender-keyed phase-1 buffer can never "
+                f"fill, so no node will merge or train in step 1 (the "
+                f"reference has the same degeneracy, node.py:777-783). "
+                f"Consider n_sampled <= {max_senders}.")
         self.n_sampled = int(n_sampled)
         self.m_top = int(m_top)
         self.step1_rounds = int(step1_rounds)
@@ -349,7 +362,7 @@ class PENSGossipSimulator(GossipSimulator):
         return state._replace(aux=aux)
 
     def start(self, state: SimState, n_rounds: int = 100,
-              key: Optional[jax.Array] = None):
+              key: Optional[jax.Array] = None, **kwargs):
         if key is None:
             key = jax.random.PRNGKey(42)
         # The phase split follows GLOBAL simulation time (node.py:732-736:
@@ -360,13 +373,14 @@ class PENSGossipSimulator(GossipSimulator):
         reports = []
         if r1 > 0:
             self._step = 1
-            state, rep1 = super().start(state, n_rounds=r1, key=key)
+            state, rep1 = super().start(state, n_rounds=r1, key=key, **kwargs)
             reports.append(rep1)
         if n_rounds - r1 > 0:
             state = self._select_neighbors(state)
             self._step = 2
             state, rep2 = super().start(state, n_rounds=n_rounds - r1,
-                                        key=jax.random.fold_in(key, 2))
+                                        key=jax.random.fold_in(key, 2),
+                                        **kwargs)
             reports.append(rep2)
         if len(reports) == 1:
             return state, reports[0]

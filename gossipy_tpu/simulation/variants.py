@@ -17,6 +17,7 @@ from ..core import AntiEntropyProtocol, CreateModelMode, MessageType
 from ..flow_control import TokenAccount
 from ..handlers.base import ModelState, PeerModel
 from .engine import GossipSimulator, PROTO_TO_MSG, SimState, select_nodes
+from .nodes import PartitioningGossipSimulator
 
 # Variant PRNG purpose tags (>= 9000; engine-internal tags stay below).
 _K_REACT_GATE = 9000       # proactive send gate
@@ -94,11 +95,16 @@ class TokenizedGossipSimulator(GossipSimulator):
             balance, jnp.where(trigger, utility, 0.0),
             self._round_key(base_key, r, _K_REACT_SLOT + k))
         reaction = jnp.where(trigger, reaction, 0)
-        balance = jnp.maximum(balance - reaction, 0)  # flow_control.py:43-52
+        # Cap at the per-round reaction budget and only debit tokens for
+        # sends that will actually be performed — tokens beyond the cap stay
+        # banked for later rounds instead of vanishing.
+        pending = state.aux["pending_reactions"]
+        performed = jnp.minimum(reaction,
+                                jnp.maximum(self.max_reactions - pending, 0))
+        performed = jnp.minimum(performed, balance)  # flow_control.py:43-52
         aux = dict(state.aux)
-        aux["balance"] = balance
-        aux["pending_reactions"] = jnp.clip(
-            state.aux["pending_reactions"] + reaction, 0, self.max_reactions)
+        aux["balance"] = balance - performed
+        aux["pending_reactions"] = pending + performed
         return state._replace(aux=aux)
 
     def _post_deliver(self, state: SimState, base_key, r):
@@ -137,6 +143,17 @@ class TokenizedGossipSimulator(GossipSimulator):
         aux = dict(state.aux)
         aux["pending_reactions"] = jnp.zeros_like(pending)
         return state._replace(aux=aux), n_sent, n_failed, total_size
+
+
+class TokenizedPartitioningGossipSimulator(TokenizedGossipSimulator,
+                                           PartitioningGossipSimulator):
+    """Token-account flow control over partitioned model exchange.
+
+    The reference composes these orthogonally: ``PartitioningBasedNode``
+    objects inside a ``TokenizedGossipSimulator`` (main_hegedus_2021.py:35-60).
+    The MRO does the same here: tokenized send gates / reactions +
+    partition-id payload hooks, both cooperative subclasses of the engine.
+    """
 
 
 class All2AllGossipSimulator(GossipSimulator):

@@ -1,0 +1,121 @@
+"""Checkpoint/resume tests (replaces reference save/load, simul.py:460-494)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gossipy_tpu.checkpoint import (
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import PegasosHandler
+from gossipy_tpu.models import AdaLine
+from gossipy_tpu.simulation import GossipSimulator
+
+
+def make_sim(n_nodes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=6)
+    X = rng.normal(size=(160, 6)).astype(np.float32)
+    y = (2 * (X @ w > 0) - 1).astype(np.float32)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n_nodes)
+    handler = PegasosHandler(AdaLine(6), learning_rate=0.01,
+                             create_model_mode=CreateModelMode.UPDATE)
+    return GossipSimulator(handler, Topology.clique(n_nodes), disp.stacked(),
+                           delta=10, protocol=AntiEntropyProtocol.PUSH)
+
+
+def states_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+class TestSaveRestore:
+    def test_roundtrip(self, tmp_path, key):
+        sim = make_sim()
+        st = sim.init_nodes(key)
+        st, _ = sim.start(st, n_rounds=3, key=key)
+        path = save_checkpoint(str(tmp_path / "ckpt"), st, key=key)
+
+        template = sim.init_nodes(jax.random.PRNGKey(7))
+        restored, rkey = restore_checkpoint(path, template, key)
+        assert states_equal(st, restored)
+        assert np.array_equal(np.asarray(rkey), np.asarray(key))
+        assert int(np.asarray(restored.round)) == 3
+
+    def test_resume_continues_identically(self, tmp_path, key):
+        """split run (3 + 4 rounds via checkpoint) == straight 7-round run.
+
+        Round randomness is keyed on the absolute round number, so resuming
+        from a restored state must reproduce the unbroken run exactly.
+        """
+        sim = make_sim()
+        st0 = sim.init_nodes(key)
+        full, _ = sim.start(st0, n_rounds=7, key=key)
+
+        part, _ = sim.start(st0, n_rounds=3, key=key)
+        path = save_checkpoint(str(tmp_path / "ckpt"), part, key=key)
+        template = sim.init_nodes(jax.random.PRNGKey(7))
+        restored, rkey = restore_checkpoint(path, template, key)
+        resumed, _ = sim.start(restored, n_rounds=4, key=rkey)
+
+        assert states_equal(full.model, resumed.model)
+
+
+class TestCheckpointManager:
+    def test_periodic_and_retention(self, tmp_path, key):
+        sim = make_sim()
+        st = sim.init_nodes(key)
+        mgr = CheckpointManager(str(tmp_path / "run"), interval=2, max_to_keep=2)
+        reports = []
+        final = mgr.run(sim, st, until_round=6, key=key, reports=reports)
+        assert int(np.asarray(final.round)) == 6
+        assert mgr.checkpoints() == [4, 6]  # retention pruned round 2
+        assert sum(len(r.get_evaluation(local=True)) for r in reports) == 6
+
+    def test_resume_from_latest(self, tmp_path, key):
+        sim = make_sim()
+        st = sim.init_nodes(key)
+        mgr = CheckpointManager(str(tmp_path / "run"), interval=2, max_to_keep=3)
+        mid = mgr.run(sim, st, until_round=4, key=key)
+        assert mgr.latest() == 4
+
+        # A fresh manager on the same dir resumes from round 4, not 0.
+        mgr2 = CheckpointManager(str(tmp_path / "run"), interval=2, max_to_keep=3)
+        final = mgr2.run(sim, sim.init_nodes(jax.random.PRNGKey(9)),
+                         until_round=8, key=key)
+        assert int(np.asarray(final.round)) == 8
+
+        straight = mgr_free_run(sim, st, 8, key)
+        assert states_equal(straight.model, final.model)
+
+
+def mgr_free_run(sim, st, n_rounds, key):
+    st, _ = sim.start(st, n_rounds=n_rounds, key=key)
+    return st
+
+
+class TestRestoreWithoutTemplateKey:
+    def test_docstring_usage_works(self, tmp_path, key):
+        """restore_checkpoint(path, template) with NO template_key must work
+        for checkpoints saved WITH a key (the documented usage)."""
+        sim = make_sim()
+        st = sim.init_nodes(key)
+        path = save_checkpoint(str(tmp_path / "ck"), st, key=key)
+        restored, rkey = restore_checkpoint(path, sim.init_nodes(jax.random.PRNGKey(3)))
+        assert states_equal(st, restored)
+        assert np.array_equal(np.asarray(rkey), np.asarray(key))
+
+    def test_keyless_checkpoint_restores(self, tmp_path, key):
+        sim = make_sim()
+        st = sim.init_nodes(key)
+        path = save_checkpoint(str(tmp_path / "ck"), st)  # no key saved
+        restored, rkey = restore_checkpoint(path, sim.init_nodes(jax.random.PRNGKey(3)))
+        assert states_equal(st, restored)
+        assert rkey is None

@@ -182,3 +182,33 @@ class TestLoaders:
         Xe, ye = h.get_eval_set()
         np.testing.assert_array_equal(Xe, h.Xtr)  # eval set IS the train set
         assert Xe.shape == (50, 3)
+
+
+class TestFEMNIST:
+    def test_per_writer_assignments_are_disjoint_and_advance(self):
+        import warnings
+        from gossipy_tpu.data import get_FEMNIST
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            (Xtr, ytr, tr_a), (Xte, yte, te_a) = get_FEMNIST(n_writers=10)
+        assert len(tr_a) == len(te_a) == 10
+        # The reference's sum_tr/sum_te bug assigned every writer the same
+        # rows; here shards must tile the dataset disjointly.
+        all_tr = np.concatenate(tr_a)
+        assert len(np.unique(all_tr)) == len(all_tr) == len(Xtr)
+        assert Xtr.shape[1:] == (28, 28, 1)
+        assert ytr.max() < 62
+
+    def test_dispatch_through_set_assignments(self):
+        import warnings
+        from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher, \
+            get_FEMNIST
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            (Xtr, ytr, tr_a), (Xte, yte, te_a) = get_FEMNIST(n_writers=6)
+        dh = ClassificationDataHandler(Xtr, ytr, Xte, yte)
+        disp = DataDispatcher(dh, n=6, eval_on_user=True, auto_assign=False)
+        disp.set_assignments(tr_a, te_a)
+        stacked = disp.stacked()
+        assert stacked["xtr"].shape[0] == 6
+        assert stacked["mtr"].sum() == len(Xtr)

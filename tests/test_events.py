@@ -1,0 +1,121 @@
+"""Event-stream tests (reference Observer pattern, simul.py:37-177)."""
+
+import jax
+import numpy as np
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import PegasosHandler
+from gossipy_tpu.models import AdaLine
+from gossipy_tpu.simulation import GossipSimulator, SimulationEventReceiver
+
+
+def make_sim(n_nodes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=6)
+    X = rng.normal(size=(160, 6)).astype(np.float32)
+    y = (2 * (X @ w > 0) - 1).astype(np.float32)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n_nodes)
+    handler = PegasosHandler(AdaLine(6), learning_rate=0.01,
+                             create_model_mode=CreateModelMode.UPDATE)
+    return GossipSimulator(handler, Topology.clique(n_nodes), disp.stacked(),
+                           delta=10, protocol=AntiEntropyProtocol.PUSH)
+
+
+class Recorder(SimulationEventReceiver):
+    def __init__(self, live=False):
+        self.live = live
+        self.rounds = []
+        self.messages = []
+        self.evals = []
+        self.ended = 0
+
+    def update_message(self, round, sent, failed, size):
+        self.messages.append((round, sent, failed, size))
+
+    def update_evaluation(self, round, on_user, metrics):
+        self.evals.append((round, on_user, metrics))
+
+    def update_timestep(self, round):
+        self.rounds.append(round)
+
+    def update_end(self):
+        self.ended += 1
+
+
+class TestReplayEvents:
+    def test_rounds_and_totals_match_report(self, key):
+        sim = make_sim()
+        rec = Recorder()
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=5, key=key)
+        assert rec.rounds == [1, 2, 3, 4, 5]
+        assert rec.ended == 1
+        assert sum(m[1] for m in rec.messages) == report.sent_messages
+        assert sum(m[3] for m in rec.messages) == report.total_size
+        # Both local (on_user) and global evaluations stream through.
+        assert any(e[1] for e in rec.evals) and any(not e[1] for e in rec.evals)
+
+    def test_receivers_are_per_instance(self, key):
+        # Reference quirk fixed: _receivers was a CLASS attribute shared by
+        # all senders (simul.py:94); here each simulator owns its list.
+        sim1, sim2 = make_sim(), make_sim()
+        rec = Recorder()
+        sim1.add_receiver(rec)
+        assert sim2._receivers_list() == []
+
+    def test_remove_receiver(self, key):
+        sim = make_sim()
+        rec = Recorder()
+        sim.add_receiver(rec)
+        sim.remove_receiver(rec)
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key)
+        assert rec.rounds == []
+
+    def test_resumed_run_continues_round_numbers(self, key):
+        sim = make_sim()
+        rec = Recorder()
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, _ = sim.start(st, n_rounds=3, key=key)
+        st, _ = sim.start(st, n_rounds=2, key=key)
+        assert rec.rounds == [1, 2, 3, 4, 5]
+
+
+class TestLiveEvents:
+    def test_live_receiver_fires_during_run(self, key):
+        sim = make_sim()
+        rec = Recorder(live=True)
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=4, key=key)
+        assert rec.rounds == [1, 2, 3, 4]
+        assert sum(m[1] for m in rec.messages) == report.sent_messages
+        # Live receivers are not double-notified by the replay pass.
+        assert len(rec.rounds) == 4
+        assert rec.ended == 1
+
+    def test_live_and_replay_coexist(self, key):
+        sim = make_sim()
+        live, replay = Recorder(live=True), Recorder()
+        sim.add_receiver(live)
+        sim.add_receiver(replay)
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=3, key=key)
+        assert live.rounds == replay.rounds == [1, 2, 3]
+        assert live.messages == replay.messages
+
+
+class TestProfiler:
+    def test_profile_dir_writes_trace(self, tmp_path, key):
+        sim = make_sim()
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key, profile_dir=str(tmp_path / "prof"))
+        import os
+        found = []
+        for root, _, files in os.walk(tmp_path / "prof"):
+            found.extend(files)
+        assert found, "profiler trace produced no files"

@@ -244,3 +244,27 @@ class TestPENS:
         with pytest.raises(AssertionError):
             PENSGossipSimulator(sgd_handler(d, mode=CreateModelMode.UPDATE),
                                 Topology.clique(8), data, delta=10)
+
+
+class TestReactiveTokenConservation:
+    def test_capped_reactions_do_not_destroy_tokens(self, key):
+        """Tokens beyond the per-round reaction cap stay banked: debits must
+        equal performed reaction sends (regression for the clip-after-debit
+        bug)."""
+        from gossipy_tpu.flow_control import GeneralizedTokenAccount
+        data, d = make_parts()
+        sim = TokenizedGossipSimulator(
+            sgd_handler(d), Topology.clique(16), data, delta=10,
+            token_account=GeneralizedTokenAccount(C=30, A=1),
+            max_reactions=2)
+        st = sim.init_nodes(key)
+        # Seed large balances so reactive() wants >> max_reactions sends.
+        aux = dict(st.aux)
+        aux["balance"] = jnp.full((16,), 30, dtype=jnp.int32)
+        st = st._replace(aux=aux)
+        st2, rep = sim.start(st, n_rounds=1, key=key)
+        spent = np.asarray(st.aux["balance"]) - np.asarray(st2.aux["balance"])
+        # Balance may also GROW by 1 for gated proactive sends; reactions can
+        # never debit more than the cap.
+        assert (spent <= sim.max_reactions).all()
+        assert (np.asarray(st2.aux["balance"]) >= 0).all()
