@@ -98,22 +98,57 @@ class Topology:
             a[idx, (idx - d) % n] = True
         return Topology(a)
 
+    # Node count above which the "auto" backend switches from networkx to
+    # the native C++ generators (gossipy_tpu/native): networkx's pure-Python
+    # generators take minutes at the node counts the TPU engine handles.
+    NATIVE_THRESHOLD = 2048
+
     @staticmethod
-    def random_regular(n: int, degree: int, seed: int = 42) -> "Topology":
-        """k-regular random graph (used by reference main_hegedus_2021.py:44)."""
+    def _use_native(n: int, backend: str) -> bool:
+        assert backend in ("auto", "networkx", "native"), \
+            f"backend must be 'auto', 'networkx' or 'native', got {backend!r}"
+        if backend == "networkx":
+            return False
+        from . import native
+        if backend == "native":
+            assert native.available(), "native graphgen unavailable (no g++?)"
+            return True
+        return n >= Topology.NATIVE_THRESHOLD and native.available()
+
+    @staticmethod
+    def random_regular(n: int, degree: int, seed: int = 42,
+                       backend: str = "auto") -> "Topology":
+        """k-regular random graph (used by reference main_hegedus_2021.py:44).
+
+        ``backend``: "networkx" (reference-matching RNG stream), "native"
+        (C++ pairing model, fast at large n), or "auto" (native above
+        ``NATIVE_THRESHOLD`` nodes). Edge sets are reproducible per
+        (backend, seed) but differ between backends.
+        """
+        if Topology._use_native(n, backend):
+            from . import native
+            return Topology(native.random_regular(n, degree, seed))
         import networkx as nx
         g = nx.random_regular_graph(degree, n, seed=seed)
         return Topology(nx.to_numpy_array(g))
 
     @staticmethod
-    def barabasi_albert(n: int, m: int, seed: int = 42) -> "Topology":
+    def barabasi_albert(n: int, m: int, seed: int = 42,
+                        backend: str = "auto") -> "Topology":
         """Preferential-attachment graph (reference main_giaretta_2019.py)."""
+        if Topology._use_native(n, backend):
+            from . import native
+            return Topology(native.barabasi_albert(n, m, seed))
         import networkx as nx
         g = nx.barabasi_albert_graph(n, m, seed=seed)
         return Topology(nx.to_numpy_array(g))
 
     @staticmethod
-    def erdos_renyi(n: int, p: float, seed: int = 42) -> "Topology":
+    def erdos_renyi(n: int, p: float, seed: int = 42,
+                    backend: str = "auto") -> "Topology":
+        if Topology._use_native(n, backend):
+            from . import native
+            return Topology(native.erdos_renyi(n, p, seed))
         import networkx as nx
         g = nx.erdos_renyi_graph(n, p, seed=seed)
         return Topology(nx.to_numpy_array(g))

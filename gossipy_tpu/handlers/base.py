@@ -62,6 +62,9 @@ class BaseHandler:
     """
 
     mode: CreateModelMode = CreateModelMode.MERGE_UPDATE
+    # True when ``merge`` is exactly the uniform parameter average with
+    # age = max (the engine's pallas fused path may then replace it).
+    uniform_avg_merge: bool = False
 
     # -- abstract ----------------------------------------------------------
     def init(self, key: jax.Array) -> ModelState:

@@ -27,6 +27,8 @@ class AdaLineHandler(BaseHandler):
     samples seen (handler.py:366).
     """
 
+    uniform_avg_merge = True
+
     def __init__(self, net: AdaLine, learning_rate: float,
                  create_model_mode: CreateModelMode = CreateModelMode.UPDATE):
         self.net = net

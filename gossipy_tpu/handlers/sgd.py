@@ -43,6 +43,8 @@ class SGDHandler(BaseHandler):
       (handler.py:282-334) in pure JAX.
     """
 
+    uniform_avg_merge = True
+
     def __init__(self,
                  model,
                  loss: Callable,
@@ -184,6 +186,8 @@ class LimitedMergeSGDHandler(SGDHandler):
     wholesale (the one with more updates wins); otherwise age-weighted average.
     """
 
+    uniform_avg_merge = False
+
     def __init__(self, *args, age_diff_threshold: int = 1, **kwargs):
         super().__init__(*args, **kwargs)
         self.L = age_diff_threshold
@@ -217,6 +221,8 @@ class SamplingSGDHandler(SGDHandler):
     the message — a key is the 2-word equivalent).
     """
 
+    uniform_avg_merge = False
+
     def __init__(self, sample_size: float, *args, **kwargs):
         super().__init__(*args, **kwargs)
         assert self.mode != CreateModelMode.PASS, \
@@ -240,6 +246,8 @@ class PartitionedSGDHandler(SGDHandler):
       (handler.py:514-520).
     ``extra`` = the (traced) partition id from the message payload.
     """
+
+    uniform_avg_merge = False
 
     def __init__(self, partition: ModelPartition, *args, **kwargs):
         super().__init__(*args, **kwargs)

@@ -1,0 +1,116 @@
+"""Native (C++) runtime components, loaded through ctypes.
+
+The reference is pure Python (SURVEY.md §2: "no C++/Rust/CUDA components"),
+so nothing here ports reference code — these are the host-side pieces that
+become bottlenecks at the node counts the TPU engine makes practical:
+
+- ``graphgen.cpp``: dense-adjacency topology generators (Erdos-Renyi,
+  pairing-model random regular, Barabasi-Albert, ring). networkx needs
+  minutes for a 20-regular 50k-node graph; the native generator writes the
+  bool adjacency straight into a numpy buffer.
+
+The shared library is built on demand with ``g++ -O3 -shared -fPIC`` and
+cached next to the source; every entry point has a pure-Python fallback
+(networkx) selected automatically when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "graphgen.cpp")
+_LIB = os.path.join(_HERE, "_graphgen.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if the cached build is missing/stale."""
+    try:
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB],
+            check=True, capture_output=True, timeout=120)
+        return _LIB
+    except Exception:
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The graphgen library, building it on first use; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        u8p = np.ctypeslib.ndpointer(dtype=np.uint8, ndim=2, flags="C_CONTIGUOUS")
+        lib.gen_erdos_renyi.argtypes = [ctypes.c_int32, ctypes.c_double,
+                                        ctypes.c_uint64, u8p]
+        lib.gen_erdos_renyi.restype = None
+        lib.gen_random_regular.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                           ctypes.c_uint64, u8p]
+        lib.gen_random_regular.restype = ctypes.c_int32
+        lib.gen_barabasi_albert.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                            ctypes.c_uint64, u8p]
+        lib.gen_barabasi_albert.restype = None
+        lib.gen_ring.argtypes = [ctypes.c_int32, ctypes.c_int32, u8p]
+        lib.gen_ring.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def erdos_renyi(n: int, p: float, seed: int = 42) -> np.ndarray:
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    adj = np.zeros((n, n), dtype=np.uint8)
+    lib.gen_erdos_renyi(n, float(p), seed, adj)
+    return adj.view(bool)  # same itemsize; zero-copy
+
+
+def random_regular(n: int, k: int, seed: int = 42) -> np.ndarray:
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    adj = np.zeros((n, n), dtype=np.uint8)
+    rc = lib.gen_random_regular(n, k, seed, adj)
+    if rc == -1:
+        raise ValueError(f"no {k}-regular graph on {n} nodes (n*k must be "
+                         f"even and k < n)")
+    if rc != 0:
+        raise RuntimeError("pairing model failed to find a simple graph")
+    return adj.view(bool)  # same itemsize; zero-copy
+
+
+def barabasi_albert(n: int, m: int, seed: int = 42) -> np.ndarray:
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    assert 1 <= m < n, "need 1 <= m < n"
+    adj = np.zeros((n, n), dtype=np.uint8)
+    lib.gen_barabasi_albert(n, m, seed, adj)
+    return adj.view(bool)  # same itemsize; zero-copy
+
+
+def ring(n: int, k: int = 1) -> np.ndarray:
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    adj = np.zeros((n, n), dtype=np.uint8)
+    lib.gen_ring(n, k, adj)
+    return adj.view(bool)  # same itemsize; zero-copy

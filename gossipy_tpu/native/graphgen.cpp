@@ -1,0 +1,151 @@
+// Fast P2P topology generation for large simulated networks.
+//
+// The reference builds its topologies with networkx on the Python side
+// (gossipy main_* scripts; StaticP2PNetwork at gossipy/core.py:364-389).
+// networkx's pure-Python generators become the setup bottleneck for
+// 10k+-node simulations (the TPU engine itself handles such node counts
+// easily), so the heavy generators live here: dense bool adjacency written
+// straight into a numpy-owned buffer through ctypes, seeded mt19937_64 for
+// reproducibility. Graph *semantics* match the classic models (G(n,p),
+// pairing-model random regular with retries, Barabasi-Albert preferential
+// attachment via the repeated-endpoints trick); exact edge sets differ from
+// networkx's RNG stream, so a topology is reproducible per (backend, seed).
+//
+// Build: see gossipy_tpu/native/__init__.py (g++ -O3 -shared -fPIC).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+extern "C" {
+
+// G(n, p): every undirected edge present independently with prob p.
+void gen_erdos_renyi(int32_t n, double p, uint64_t seed, uint8_t* adj) {
+    std::mt19937_64 rng(seed);
+    std::uniform_real_distribution<double> u(0.0, 1.0);
+    std::memset(adj, 0, (size_t)n * n);
+    for (int32_t i = 0; i < n; ++i) {
+        for (int32_t j = i + 1; j < n; ++j) {
+            if (u(rng) < p) {
+                adj[(size_t)i * n + j] = 1;
+                adj[(size_t)j * n + i] = 1;
+            }
+        }
+    }
+}
+
+// k-regular random graph via the pairing (configuration) model with
+// edge-swap repair: shuffle k copies of every vertex, pair adjacent stubs,
+// then fix self-loops/multi-edges by double-edge swaps against random good
+// edges (whole-graph rejection has acceptance ~e^{-k^2/4} — hopeless for
+// k=20; local swaps preserve the degree sequence and a near-uniform draw).
+// Returns 0 on success, -1 if n*k is odd or k >= n, -2 if repair failed.
+int32_t gen_random_regular(int32_t n, int32_t k, uint64_t seed, uint8_t* adj) {
+    if (k >= n || ((int64_t)n * k) % 2 != 0) return -1;
+    std::mt19937_64 rng(seed);
+    std::vector<int32_t> stubs((size_t)n * k);
+    for (int32_t v = 0; v < n; ++v)
+        for (int32_t c = 0; c < k; ++c) stubs[(size_t)v * k + c] = v;
+
+    for (int attempt = 0; attempt < 20; ++attempt) {
+        std::shuffle(stubs.begin(), stubs.end(), rng);
+        std::memset(adj, 0, (size_t)n * n);
+        // Accept all pairs; remember the conflicting ones for repair.
+        std::vector<std::pair<int32_t, int32_t>> edges;   // good edges
+        std::vector<std::pair<int32_t, int32_t>> bad;     // loops/dups
+        edges.reserve(stubs.size() / 2);
+        for (size_t s = 0; s + 1 < stubs.size(); s += 2) {
+            int32_t a = stubs[s], b = stubs[s + 1];
+            if (a == b || adj[(size_t)a * n + b]) {
+                bad.emplace_back(a, b);
+            } else {
+                adj[(size_t)a * n + b] = 1;
+                adj[(size_t)b * n + a] = 1;
+                edges.emplace_back(a, b);
+            }
+        }
+        // Repair: swap each bad pair (a,b) with a random good edge (c,d):
+        // (a,b),(c,d) -> (a,c),(b,d). Valid iff both new edges are simple.
+        bool ok = true;
+        if (edges.empty() && !bad.empty()) ok = false;  // nothing to swap with
+        for (auto& ab : bad) {
+            if (!ok) break;
+            int32_t a = ab.first, b = ab.second;
+            bool fixed = false;
+            for (int tries = 0; tries < 2000 && !fixed; ++tries) {
+                std::uniform_int_distribution<size_t> d(0, edges.size() - 1);
+                size_t ei = d(rng);
+                int32_t c = edges[ei].first, e = edges[ei].second;
+                // Randomize orientation of the picked edge.
+                if (rng() & 1) std::swap(c, e);
+                if (a == c || a == e || b == c || b == e) continue;
+                if (adj[(size_t)a * n + c] || adj[(size_t)b * n + e]) continue;
+                adj[(size_t)c * n + e] = 0;
+                adj[(size_t)e * n + c] = 0;
+                adj[(size_t)a * n + c] = 1;
+                adj[(size_t)c * n + a] = 1;
+                adj[(size_t)b * n + e] = 1;
+                adj[(size_t)e * n + b] = 1;
+                edges[ei] = {a, c};
+                edges.emplace_back(b, e);
+                fixed = true;
+            }
+            if (!fixed) { ok = false; break; }
+        }
+        if (ok) return 0;
+    }
+    return -2;
+}
+
+// Barabasi-Albert preferential attachment: start from m connected seeds,
+// attach each new node to m distinct targets drawn from the
+// repeated-endpoints list (degree-proportional).
+void gen_barabasi_albert(int32_t n, int32_t m, uint64_t seed, uint8_t* adj) {
+    std::mt19937_64 rng(seed);
+    std::memset(adj, 0, (size_t)n * n);
+    if (m < 1 || n <= m) return;
+    std::vector<int32_t> endpoints;  // every edge contributes both endpoints
+    endpoints.reserve((size_t)2 * m * n);
+    // Seed: star over the first m+1 nodes (connected, every node has degree>=1).
+    for (int32_t v = 1; v <= m; ++v) {
+        adj[(size_t)0 * n + v] = 1;
+        adj[(size_t)v * n + 0] = 1;
+        endpoints.push_back(0);
+        endpoints.push_back(v);
+    }
+    std::vector<int32_t> targets(m);
+    for (int32_t v = m + 1; v < n; ++v) {
+        int32_t picked = 0;
+        while (picked < m) {
+            std::uniform_int_distribution<size_t> d(0, endpoints.size() - 1);
+            int32_t t = endpoints[d(rng)];
+            bool dup = (t == v) || adj[(size_t)v * n + t];
+            for (int32_t q = 0; q < picked && !dup; ++q)
+                if (targets[q] == t) dup = true;
+            if (!dup) targets[picked++] = t;
+        }
+        for (int32_t q = 0; q < m; ++q) {
+            int32_t t = targets[q];
+            adj[(size_t)v * n + t] = 1;
+            adj[(size_t)t * n + v] = 1;
+            endpoints.push_back(v);
+            endpoints.push_back(t);
+        }
+    }
+}
+
+// Ring lattice: each node linked to its k nearest neighbors per side.
+void gen_ring(int32_t n, int32_t k, uint8_t* adj) {
+    std::memset(adj, 0, (size_t)n * n);
+    for (int32_t i = 0; i < n; ++i) {
+        for (int32_t d = 1; d <= k; ++d) {
+            int32_t a = (i + d) % n, b = ((i - d) % n + n) % n;
+            adj[(size_t)i * n + a] = 1;
+            adj[(size_t)i * n + b] = 1;
+        }
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the simulation hot paths."""
+
+from .merge import gather_merge_flat, gather_merge_pytree
+
+__all__ = ["gather_merge_flat", "gather_merge_pytree"]

@@ -1,0 +1,124 @@
+"""Fused peer-gather + weighted-merge pallas kernel.
+
+The deliver phase of the gossip engine is HBM-bandwidth bound: for every
+receiver ``i`` it reads the sender's snapshot row ``H[flat_idx[i]]`` from the
+params-history ring and blends it with the receiver's own row,
+
+    out[i] = w_self[i] * P[i] + w_peer[i] * H[flat_idx[i]]
+
+(the pytree form of ``TorchModelHandler._merge``'s uniform average, reference
+gossipy/model/handler.py:260-280, with the gather standing in for the
+reference's ``CACHE.pop`` model fetch). Composed from jnp primitives this is
+a gather (one full HBM round-trip to materialize the peer copy) followed by
+an elementwise blend (a second read + write). The pallas kernel fuses them:
+each (row, feature-block) program DMAs the sender block HBM->VMEM directly
+(its row chosen by a scalar-prefetched index map) and writes the blended
+block — the gathered peer copy is never materialized.
+
+Layout notes (pallas_guide.md): feature blocks of 512 lanes (multiple of the
+128-lane tile), scalar prefetch for the row indices and blend weights so the
+DMA source of each grid step is known before the body runs. Off-TPU the same
+kernel runs in interpreter mode (used by the CPU test mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_F = 512
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+try:  # pallas is TPU/GPU-oriented; import guarded so CPU-only installs work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _kernel(idx_ref, w_self_ref, w_peer_ref, p_ref, h_ref, o_ref):
+    i = pl.program_id(0)
+    o_ref[:] = w_self_ref[i] * p_ref[:] + w_peer_ref[i] * h_ref[:]
+
+
+def gather_merge_reference(p: jax.Array, h: jax.Array, idx: jax.Array,
+                           w_self: jax.Array, w_peer: jax.Array) -> jax.Array:
+    """jnp fallback: materializes the gather (what XLA does un-fused)."""
+    peer = h[idx]
+    return w_self[:, None] * p + w_peer[:, None] * peer
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_f"))
+def _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret: bool,
+                         block_f: int):
+    n, f = p.shape
+    pad = (-f) % block_f
+    if pad:
+        p = jnp.pad(p, ((0, 0), (0, pad)))
+        h = jnp.pad(h, ((0, 0), (0, pad)))
+    fp = f + pad
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n, fp // block_f),
+        in_specs=[
+            pl.BlockSpec((1, block_f), lambda i, j, s, w1, w2: (i, j)),
+            pl.BlockSpec((1, block_f), lambda i, j, s, w1, w2: (s[i], j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_f), lambda i, j, s, w1, w2: (i, j)),
+    )
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, fp), p.dtype),
+        interpret=interpret,
+    )(idx.astype(jnp.int32), w_self.astype(p.dtype), w_peer.astype(p.dtype),
+      p, h)
+    return out[:, :f] if pad else out
+
+
+def gather_merge_flat(p: jax.Array, h: jax.Array, idx: jax.Array,
+                      w_self: jax.Array, w_peer: jax.Array,
+                      interpret: Optional[bool] = None,
+                      block_f: int = BLOCK_F) -> jax.Array:
+    """``out[i] = w_self[i] * p[i] + w_peer[i] * h[idx[i]]``.
+
+    ``p`` is [N, F]; ``h`` is [M, F] (e.g. the [D*N, F]-flattened history
+    ring); ``idx`` int32 [N] in [0, M); weights are [N]. ``interpret=None``
+    auto-selects interpreter mode off-TPU.
+    """
+    if not _HAS_PALLAS:
+        return gather_merge_reference(p, h, idx, w_self, w_peer)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret,
+                                int(block_f))
+
+
+def gather_merge_pytree(params, history, flat_idx: jax.Array,
+                        w_self: jax.Array, w_peer: jax.Array,
+                        interpret: Optional[bool] = None):
+    """Leafwise fused gather-merge over a stacked params pytree.
+
+    ``params`` leaves are [N, ...]; ``history`` leaves are [D, N, ...]
+    (the engine's snapshot ring); ``flat_idx[i] = (send_round_i % D) * N +
+    sender_i`` addresses the ring as a flat [D*N, F] table.
+    """
+    def leaf(pl_, hl):
+        n = pl_.shape[0]
+        f = int(np.prod(pl_.shape[1:])) if pl_.ndim > 1 else 1
+        out = gather_merge_flat(pl_.reshape(n, f),
+                                hl.reshape(hl.shape[0] * hl.shape[1], f),
+                                flat_idx, w_self, w_peer, interpret=interpret)
+        return out.reshape(pl_.shape)
+
+    return jax.tree.map(leaf, params, history)

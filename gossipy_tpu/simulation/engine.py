@@ -159,6 +159,12 @@ class GossipSimulator(SimulationEventSender):
     message_size : int | None
         Payload size in scalars for delay/size accounting; defaults to the
         handler's model parameter count.
+    fused_merge : bool
+        Use the pallas fused gather+merge kernel (:mod:`gossipy_tpu.ops`) in
+        the deliver phase instead of gather-then-blend. Only valid for
+        MERGE_UPDATE handlers whose merge is the uniform parameter average
+        (``handler.uniform_avg_merge``); numerically equivalent up to fp
+        reassociation.
     """
 
     def __init__(self,
@@ -174,7 +180,8 @@ class GossipSimulator(SimulationEventSender):
                  sync: bool = True,
                  mailbox_slots: int = 4,
                  reply_slots: int = 2,
-                 message_size: Optional[int] = None):
+                 message_size: Optional[int] = None,
+                 fused_merge: bool = False):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         self.handler = handler
         self.topology = topology
@@ -195,6 +202,21 @@ class GossipSimulator(SimulationEventSender):
         self._message_size = message_size
         self._metric_names: Optional[list[str]] = None
         self._jit_cache: dict = {}
+
+        self.fused_merge = bool(fused_merge)
+        if self.fused_merge:
+            # The fused kernel replaces the whole gather->decode->apply slot
+            # pipeline; any variant customizing one of those hooks would be
+            # silently bypassed.
+            for hook in ("_apply_receive", "_gather_peer", "_decode_extra"):
+                assert getattr(type(self), hook) is getattr(GossipSimulator, hook), \
+                    f"fused_merge requires the base receive path ({hook} is " \
+                    f"overridden by {type(self).__name__})"
+            assert getattr(handler, "uniform_avg_merge", False), \
+                "fused_merge requires a uniform-average merge handler"
+            from ..core import CreateModelMode
+            assert handler.mode == CreateModelMode.MERGE_UPDATE, \
+                "fused_merge only fuses the MERGE_UPDATE path"
 
     # -- setup -------------------------------------------------------------
 
@@ -359,6 +381,17 @@ class GossipSimulator(SimulationEventSender):
         ages = state.history_ages[b, s]
         return PeerModel(params, ages)
 
+    def _receive_slot_apply(self, state: SimState, send_round, sender, extra,
+                            valid, call_key) -> SimState:
+        """Process one mailbox slot: fetch the senders' snapshots and apply
+        the handler's receive behavior (gather + blend, or the fused pallas
+        path when enabled)."""
+        if self.fused_merge:
+            return self._fused_receive(state, send_round, sender, valid,
+                                       call_key)
+        peer = self._gather_peer(state, send_round, sender)
+        return self._apply_receive(state, peer, extra, valid, call_key)
+
     def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
                        call_key) -> SimState:
         """Vmapped ``handler.call`` masked by ``valid`` (one mailbox slot)."""
@@ -369,6 +402,29 @@ class GossipSimulator(SimulationEventSender):
                              in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
                              )(state.model, peer, data, keys, extra_arg)
         return state._replace(model=select_nodes(valid, new_model, state.model))
+
+    def _fused_receive(self, state: SimState, send_round, sender, valid,
+                       call_key) -> SimState:
+        """MERGE_UPDATE via the pallas fused gather+merge kernel: the peer
+        snapshot is blended into the receiver's params during the gather
+        itself (one HBM pass; see gossipy_tpu/ops/merge.py), then the
+        standard vmapped local update runs. Produces the same results as the
+        unfused path up to fp reassociation (same PRNG streams)."""
+        from ..ops import gather_merge_pytree
+        n = self.n_nodes
+        D = state.history_ages.shape[0]
+        s = jnp.clip(sender, 0, n - 1)
+        flat_idx = ((send_round % D) * n + s).astype(jnp.int32)
+        w_peer = jnp.where(valid, 0.5, 0.0).astype(jnp.float32)
+        w_self = 1.0 - w_peer
+        merged_params = gather_merge_pytree(
+            state.model.params, state.history_params, flat_idx, w_self, w_peer)
+        peer_ages = state.history_ages[send_round % D, s]
+        merged = ModelState(merged_params, state.model.opt_state,
+                            jnp.maximum(state.model.n_updates, peer_ages))
+        keys = jax.random.split(call_key, n)
+        updated = jax.vmap(self.handler.update)(merged, self._local_data(), keys)
+        return state._replace(model=select_nodes(valid, updated, state.model))
 
     def _decode_extra(self, extra: jax.Array):
         """Map the int32 wire field to the handler's ``extra`` argument.
@@ -399,9 +455,8 @@ class GossipSimulator(SimulationEventSender):
             carries_model = (ty == MessageType.PUSH) | \
                             (ty == MessageType.PUSH_PULL) | \
                             (ty == MessageType.REPLY)
-            peer = self._gather_peer(state, sr, sender)
-            state = self._apply_receive(
-                state, peer, extra, valid & carries_model,
+            state = self._receive_slot_apply(
+                state, sr, sender, extra, valid & carries_model,
                 self._round_key(base_key, r, _K_CALL * 101 + k))
 
             if self._replies_possible():
@@ -469,9 +524,9 @@ class GossipSimulator(SimulationEventSender):
             occupied = sender >= 0
             valid = occupied & online
             n_failed += (occupied & ~online).sum()
-            peer = self._gather_peer(state, state.reply_box.send_round[b, :, k], sender)
-            state = self._apply_receive(
-                state, peer, state.reply_box.extra[b, :, k], valid,
+            state = self._receive_slot_apply(
+                state, state.reply_box.send_round[b, :, k], sender,
+                state.reply_box.extra[b, :, k], valid,
                 self._round_key(base_key, r, (_K_CALL + 53) * 101 + k))
         state = state._replace(reply_box=state.reply_box.clear_cell(b))
         return state, n_failed

@@ -1,0 +1,79 @@
+"""Native C++ graphgen tests (gossipy_tpu/native)."""
+
+import numpy as np
+import pytest
+
+from gossipy_tpu import native
+from gossipy_tpu.core import Topology
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="g++ unavailable")
+
+
+class TestGenerators:
+    def test_random_regular_is_regular_symmetric(self):
+        adj = native.random_regular(200, 6, seed=7)
+        assert adj.shape == (200, 200)
+        assert (adj == adj.T).all()
+        assert not np.diag(adj).any()
+        assert (adj.sum(axis=1) == 6).all()
+
+    def test_random_regular_deterministic_per_seed(self):
+        a = native.random_regular(100, 4, seed=1)
+        b = native.random_regular(100, 4, seed=1)
+        c = native.random_regular(100, 4, seed=2)
+        assert (a == b).all()
+        assert (a != c).any()
+
+    def test_random_regular_invalid_args(self):
+        with pytest.raises(ValueError):
+            native.random_regular(5, 3, seed=0)  # n*k odd
+
+    def test_barabasi_albert_degrees(self):
+        adj = native.barabasi_albert(300, 5, seed=3)
+        assert (adj == adj.T).all()
+        assert not np.diag(adj).any()
+        deg = adj.sum(axis=1)
+        assert (deg >= 5).all()          # every non-seed node attaches m edges
+        assert deg.max() > 2 * 5         # hubs emerge (power law)
+        # connected: BFS reaches everyone
+        seen = np.zeros(300, dtype=bool)
+        stack = [0]
+        seen[0] = True
+        while stack:
+            v = stack.pop()
+            for u in np.where(adj[v])[0]:
+                if not seen[u]:
+                    seen[u] = True
+                    stack.append(int(u))
+        assert seen.all()
+
+    def test_erdos_renyi_density(self):
+        adj = native.erdos_renyi(400, 0.1, seed=5)
+        assert (adj == adj.T).all()
+        density = adj.sum() / (400 * 399)
+        assert 0.07 < density < 0.13
+
+    def test_ring(self):
+        adj = native.ring(10, 2)
+        assert (adj.sum(axis=1) == 4).all()
+        assert adj[0, 1] and adj[0, 2] and adj[0, 9] and adj[0, 8]
+
+
+class TestTopologyBackends:
+    def test_backend_native_used_and_valid(self):
+        t = Topology.random_regular(64, 4, seed=9, backend="native")
+        assert (t.degrees == 4).all()
+
+    def test_backend_networkx_matches_reference_stream(self):
+        import networkx as nx
+        t = Topology.random_regular(50, 4, seed=9, backend="networkx")
+        g = nx.random_regular_graph(4, 50, seed=9)
+        assert (t.adjacency == nx.to_numpy_array(g).astype(bool)).all()
+
+    def test_auto_threshold(self):
+        # below threshold -> networkx stream
+        import networkx as nx
+        t = Topology.barabasi_albert(40, 3, seed=2)  # auto, small
+        g = nx.barabasi_albert_graph(40, 3, seed=2)
+        assert (t.adjacency == nx.to_numpy_array(g).astype(bool)).all()

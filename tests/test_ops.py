@@ -1,0 +1,101 @@
+"""Pallas fused gather+merge kernel tests (gossipy_tpu/ops)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology, UniformDelay
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import LimitedMergeSGDHandler, SGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.ops import gather_merge_flat, gather_merge_pytree
+from gossipy_tpu.ops.merge import gather_merge_reference
+from gossipy_tpu.simulation import GossipSimulator
+
+
+class TestKernel:
+    @pytest.mark.parametrize("n,m,f", [(16, 48, 116), (8, 8, 512), (5, 10, 1),
+                                       (32, 96, 640)])
+    def test_matches_reference(self, n, m, f):
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+        h = jnp.asarray(rng.normal(size=(m, f)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+        w1 = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+        got = gather_merge_flat(p, h, idx, w1, 1.0 - w1)
+        want = gather_merge_reference(p, h, idx, w1, 1.0 - w1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pytree_form(self):
+        rng = np.random.default_rng(1)
+        n, d_hist = 6, 3
+        params = {"w": jnp.asarray(rng.normal(size=(n, 4, 5)).astype(np.float32)),
+                  "b": jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))}
+        hist = jax.tree.map(
+            lambda l: jnp.asarray(rng.normal(
+                size=(d_hist,) + l.shape).astype(np.float32)), params)
+        flat_idx = jnp.asarray(rng.integers(0, d_hist * n, n).astype(np.int32))
+        w1 = jnp.full((n,), 0.5, jnp.float32)
+        out = gather_merge_pytree(params, hist, flat_idx, w1, 1.0 - w1)
+        for k in params:
+            hflat = hist[k].reshape((d_hist * n,) + params[k].shape[1:])
+            want = 0.5 * params[k] + 0.5 * hflat[flat_idx]
+            np.testing.assert_allclose(np.asarray(out[k]), np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def make_sim(fused, key, d=8, n_nodes=12, **kw):
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(240, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n_nodes)
+    handler = SGDHandler(model=LogisticRegression(d, 2), loss=losses.cross_entropy,
+                         optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8,
+                         n_classes=2, input_shape=(d,),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    return GossipSimulator(handler, Topology.clique(n_nodes), disp.stacked(),
+                           delta=10, fused_merge=fused, **kw)
+
+
+class TestEngineFusedPath:
+    def test_fused_equals_unfused(self, key):
+        """The fused pallas deliver path must reproduce the gather+blend path
+        (same PRNG streams; fp reassociation only)."""
+        sim_a = make_sim(False, key)
+        sim_b = make_sim(True, key)
+        st_a = sim_a.init_nodes(key)
+        st_b = sim_b.init_nodes(key)
+        fa, ra = sim_a.start(st_a, n_rounds=6, key=key)
+        fb, rb = sim_b.start(st_b, n_rounds=6, key=key)
+        for la, lb in zip(jax.tree_util.tree_leaves(fa.model.params),
+                          jax.tree_util.tree_leaves(fb.model.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ra.curves(local=False)["accuracy"],
+                                   rb.curves(local=False)["accuracy"],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_with_delays_and_replies(self, key):
+        sim = make_sim(True, key, protocol=AntiEntropyProtocol.PUSH_PULL,
+                       delay=UniformDelay(0, 15))
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=8, key=key)
+        assert rep.curves(local=False)["accuracy"][-1] > 0.8
+
+    def test_fused_rejects_non_uniform_merge_handler(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(60, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=4)
+        handler = LimitedMergeSGDHandler(
+            model=LogisticRegression(4, 2), loss=losses.cross_entropy,
+            n_classes=2, input_shape=(4,))
+        with pytest.raises(AssertionError):
+            GossipSimulator(handler, Topology.clique(4), disp.stacked(),
+                            fused_merge=True)
