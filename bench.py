@@ -50,7 +50,7 @@ def bench_ours(X, y) -> float:
     import jax
     import optax
 
-    from gossipy_tpu.core import AntiEntropyProtocol, Topology
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
     from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
     from gossipy_tpu.handlers import SGDHandler, losses
     from gossipy_tpu.models import LogisticRegression
@@ -61,21 +61,37 @@ def bench_ours(X, y) -> float:
     handler = SGDHandler(model=LogisticRegression(X.shape[1], 2),
                          loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
                          local_epochs=1, batch_size=32, n_classes=2,
-                         input_shape=(X.shape[1],))
-    sim = GossipSimulator(handler, Topology.random_regular(N_NODES, DEGREE, seed=42),
-                          disp.stacked(), delta=ROUND_LEN,
-                          protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    state = sim.init_nodes(key)
-    # Warmup: trigger compilation of the scan.
-    s2, _ = sim.start(state, n_rounds=BENCH_ROUNDS, key=key)
-    jax.block_until_ready(s2.model.params)
-    t0 = time.perf_counter()
-    s3, report = sim.start(state, n_rounds=BENCH_ROUNDS, key=key)
-    jax.block_until_ready(s3.model.params)
-    elapsed = time.perf_counter() - t0
-    acc = report.curves(local=False)["accuracy"][-1]
-    print(f"[bench] ours: {BENCH_ROUNDS} rounds in {elapsed:.2f}s "
+                         input_shape=(X.shape[1],),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+    def run(fused: bool) -> tuple[float, float]:
+        sim = GossipSimulator(handler,
+                              Topology.random_regular(N_NODES, DEGREE, seed=42),
+                              disp.stacked(), delta=ROUND_LEN,
+                              protocol=AntiEntropyProtocol.PUSH,
+                              fused_merge=fused)
+        key = jax.random.PRNGKey(42)
+        state = sim.init_nodes(key)
+        # Warmup: trigger compilation of the scan.
+        s2, _ = sim.start(state, n_rounds=BENCH_ROUNDS, key=key)
+        jax.block_until_ready(s2.model.params)
+        t0 = time.perf_counter()
+        s3, report = sim.start(state, n_rounds=BENCH_ROUNDS, key=key)
+        jax.block_until_ready(s3.model.params)
+        elapsed = time.perf_counter() - t0
+        return elapsed, report.curves(local=False)["accuracy"][-1]
+
+    elapsed, acc = run(False)
+    label = "plain"
+    try:  # pallas fused deliver path: keep whichever is faster on this chip
+        elapsed_f, acc_f = run(True)
+        print(f"[bench] fused: {BENCH_ROUNDS} rounds in {elapsed_f:.2f}s",
+              file=sys.stderr)
+        if elapsed_f < elapsed:
+            elapsed, acc, label = elapsed_f, acc_f, "fused"
+    except Exception as e:  # kernel unavailable on this backend
+        print(f"[bench] fused path unavailable ({e!r})", file=sys.stderr)
+    print(f"[bench] ours ({label}): {BENCH_ROUNDS} rounds in {elapsed:.2f}s "
           f"({BENCH_ROUNDS/elapsed:.1f} r/s), final global acc {acc:.3f}",
           file=sys.stderr)
     return BENCH_ROUNDS / elapsed

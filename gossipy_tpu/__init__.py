@@ -24,12 +24,36 @@ Layout (mirrors the reference's layer map, see SURVEY.md §1; modules marked
 
 from __future__ import annotations
 
+import logging
 import random as _py_random
 
 import jax
 import numpy as np
 
 __version__ = "0.1.0"
+
+
+class DuplicateFilter(logging.Filter):
+    """Suppress repeated log records (reference gossipy/__init__.py:94-108).
+
+    The reference wraps its rich logger with a filter that drops messages
+    already seen; same behavior here on the stdlib logger (rich is not a
+    dependency of this package)."""
+
+    def __init__(self):
+        super().__init__()
+        self._seen: set[str] = set()
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        msg = record.getMessage()
+        if msg in self._seen:
+            return False
+        self._seen.add(msg)
+        return True
+
+
+LOG = logging.getLogger("gossipy_tpu")
+LOG.addFilter(DuplicateFilter())
 
 
 def set_seed(seed: int = 42) -> jax.Array:

@@ -19,12 +19,12 @@ in the returned metadata and by a warning).
 
 from __future__ import annotations
 
-import logging
 import warnings
 from typing import Callable, Optional
 
 import numpy as np
 
+from .. import LOG  # package logger (DuplicateFilter wiring lives there)
 from .handler import (
     ClassificationDataHandler,
     ClusteringDataHandler,
@@ -32,8 +32,6 @@ from .handler import (
     RecSysDataHandler,
     RegressionDataHandler,
 )
-
-LOG = logging.getLogger("gossipy_tpu")
 
 __all__ = [
     "AssignmentHandler", "DataDispatcher", "RecSysDataDispatcher",

@@ -54,7 +54,8 @@ class SGDHandler(BaseHandler):
                  batch_size: int = 32,
                  n_classes: int = 2,
                  input_shape: Sequence[int] = (2,),
-                 create_model_mode: CreateModelMode = CreateModelMode.MERGE_UPDATE):
+                 create_model_mode: CreateModelMode = CreateModelMode.MERGE_UPDATE,
+                 compute_dtype: Optional[Any] = None):
         assert (batch_size == 0 and local_epochs > 0) or batch_size > 0, \
             "batch_size == 0 (full batch) requires local_epochs > 0"  # handler.py:226
         self.model = model
@@ -65,10 +66,19 @@ class SGDHandler(BaseHandler):
         self.n_classes = n_classes
         self.input_shape = tuple(input_shape)
         self.mode = create_model_mode
+        # Mixed precision: cast params+inputs to this dtype for the forward/
+        # backward pass (bfloat16 keeps the MXU fed at full rate on TPU);
+        # master params, optimizer state and merges stay float32. No
+        # reference analogue (torch runs f32 end to end).
+        self.compute_dtype = compute_dtype
 
     # -- model plumbing ----------------------------------------------------
 
     def apply(self, params, x):
+        if self.compute_dtype is not None:
+            params = jax.tree.map(lambda a: a.astype(self.compute_dtype), params)
+            x = x.astype(self.compute_dtype)
+            return self.model.apply({"params": params}, x).astype(jnp.float32)
         return self.model.apply({"params": params}, x)
 
     def init(self, key: jax.Array) -> ModelState:

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..simulation.engine import Mailbox, SimState
 
 NODE_AXIS = "nodes"
+DCN_AXIS = "dcn"
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Mesh:
@@ -39,25 +40,76 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Me
     return Mesh(np.array(devs), (axis_name,))
 
 
-def _spec_for_rank(lead_axis_pos: int, ndim: int, axis_name: str) -> P:
-    """PartitionSpec placing ``axis_name`` at position ``lead_axis_pos``."""
+def make_mesh_2d(n_hosts: int, devices_per_host: Optional[int] = None,
+                 axis_names: tuple[str, str] = (DCN_AXIS, NODE_AXIS)) -> Mesh:
+    """A 2-D ``(dcn, nodes)`` mesh for multi-host layouts.
+
+    The outer axis spans hosts (slow DCN links), the inner axis the chips
+    within a host (fast ICI) — the standard pjit multi-pod recipe: shard the
+    node axis over BOTH axes (``P(("dcn", "nodes"))``) so neighbor gathers
+    stay mostly intra-host while the population still spans all hosts.
+    """
+    devs = jax.devices()
+    per = devices_per_host or len(devs) // n_hosts
+    assert n_hosts * per <= len(devs), \
+        f"requested {n_hosts}x{per} devices, have {len(devs)}"
+    try:
+        # On real multi-host hardware, plain jax.devices() order is NOT
+        # guaranteed host-contiguous; the hybrid mesh helper places the DCN
+        # axis on actual host boundaries.
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (per,), (n_hosts,), devices=devs[: n_hosts * per])
+        arr = np.asarray(arr).reshape(n_hosts, per)
+    except Exception:
+        # Single-process backends (CPU test mesh, one-host TPU) have no host
+        # boundaries to respect — a plain reshape is exact. On a real
+        # multi-process run a failed hybrid mesh must NOT silently degrade
+        # to device order (the dcn axis would cut across ICI).
+        if jax.process_count() > 1:
+            raise
+        arr = np.array(devs[: n_hosts * per]).reshape(n_hosts, per)
+    return Mesh(arr, axis_names)
+
+
+def _spec_for_rank(lead_axis_pos: int, ndim: int, axis_name) -> P:
+    """PartitionSpec placing ``axis_name`` (a mesh axis name or a tuple of
+    them, for 2-D meshes) at position ``lead_axis_pos``."""
     dims = [None] * ndim
     dims[lead_axis_pos] = axis_name
     return P(*dims)
 
 
+def _node_axis_entry(mesh: Mesh, axis_name):
+    """The PartitionSpec entry for the node dimension.
+
+    ``axis_name=None`` (the default) derives it from the mesh: the single
+    axis of a 1-D mesh, or ALL axes combined on a multi-axis mesh (the node
+    population spans hosts x chips). An explicitly passed ``axis_name`` is
+    honored verbatim — a caller with a custom multi-axis mesh can pin the
+    node dimension to one axis.
+    """
+    if axis_name is not None:
+        return axis_name
+    if len(mesh.axis_names) > 1:
+        return tuple(mesh.axis_names)
+    return NODE_AXIS
+
+
 def state_shardings(state: SimState, mesh: Mesh,
-                    axis_name: str = NODE_AXIS) -> SimState:
+                    axis_name=None) -> SimState:
     """A SimState-shaped pytree of NamedShardings.
 
     - model / phase leaves: node axis leading -> ``P("nodes", ...)``
     - history / mailbox leaves: ``[D, N, ...]`` -> ``P(None, "nodes", ...)``
     - scalars (round counter): replicated
     """
+    entry = _node_axis_entry(mesh, axis_name)
+
     def shard(leaf, pos):
         if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, _spec_for_rank(pos, leaf.ndim, axis_name))
+        return NamedSharding(mesh, _spec_for_rank(pos, leaf.ndim, entry))
 
     model_sh = jax.tree.map(lambda l: shard(l, 0), state.model)
     phase_sh = shard(state.phase, 0)
@@ -74,14 +126,15 @@ def state_shardings(state: SimState, mesh: Mesh,
 
 
 def shard_state(state: SimState, mesh: Mesh,
-                axis_name: str = NODE_AXIS) -> SimState:
+                axis_name=None) -> SimState:
     """Place a SimState onto the mesh, node axis sharded."""
     return jax.device_put(state, state_shardings(state, mesh, axis_name))
 
 
-def shard_data(data: dict, mesh: Mesh, axis_name: str = NODE_AXIS) -> dict:
+def shard_data(data: dict, mesh: Mesh, axis_name=None) -> dict:
     """Shard stacked data: per-node arrays over the node axis, the global
     eval set replicated."""
+    entry = _node_axis_entry(mesh, axis_name)
     out = {}
     for k, v in data.items():
         arr = jax.numpy.asarray(v)
@@ -89,5 +142,5 @@ def shard_data(data: dict, mesh: Mesh, axis_name: str = NODE_AXIS) -> dict:
             out[k] = jax.device_put(arr, NamedSharding(mesh, P()))
         else:
             out[k] = jax.device_put(
-                arr, NamedSharding(mesh, _spec_for_rank(0, arr.ndim, axis_name)))
+                arr, NamedSharding(mesh, _spec_for_rank(0, arr.ndim, entry)))
     return out

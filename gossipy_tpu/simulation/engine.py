@@ -636,6 +636,30 @@ class GossipSimulator(SimulationEventSender):
         mutable static config (e.g. the PENS phase)."""
         return 0
 
+    # -- persistence (API parity with reference simul.py:460-494) -----------
+
+    def save(self, path: str, state: SimState,
+             key: Optional[jax.Array] = None) -> str:
+        """Checkpoint a simulation state (reference ``GossipSimulator.save``
+        dill-dumps the whole simulator + CACHE; here the state pytree IS the
+        whole world — see gossipy_tpu/checkpoint.py)."""
+        from ..checkpoint import save_checkpoint
+        return save_checkpoint(path, state, key=key)
+
+    def load(self, path: str, key: Optional[jax.Array] = None, mesh=None):
+        """Restore ``(state, key)`` saved by :meth:`save`. The simulator
+        itself is reconstructed from code + config (unlike the reference's
+        pickled object graph), so call this on a simulator built with the
+        same configuration. Pass ``mesh`` to restore a checkpoint from a
+        sharded run directly INTO the mesh's node-axis shardings (restores
+        go to the template's placement, not the file-recorded one)."""
+        from ..checkpoint import restore_checkpoint
+        template = self.init_nodes(jax.random.PRNGKey(0), local_train=False)
+        if mesh is not None:
+            from ..parallel import shard_state
+            template = shard_state(template, mesh)
+        return restore_checkpoint(path, template, key)
+
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None,
               profile_dir: Optional[str] = None) -> tuple[SimState, SimulationReport]:

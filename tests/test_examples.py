@@ -1,0 +1,44 @@
+"""Smoke tests for the reproduction scripts (examples/).
+
+Each script must run end-to-end at tiny scale and print the one-line JSON
+summary. Runs through subprocess with the CPU backend (examples default to
+whatever backend the environment provides; tests must not depend on TPU)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASES = [
+    ("main_ormandi_2013.py", ["--nodes", "24", "--rounds", "2"]),
+    ("main_danner_2023.py", ["--nodes", "12", "--rounds", "2"]),
+    ("main_all2all.py", ["--nodes", "12", "--rounds", "2"]),
+]
+
+
+def run_example(script, args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Drop TPU-plugin sitecustomize entries, same as conftest's re-exec.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p])
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)] + args,
+        capture_output=True, text=True, timeout=500, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = [l for l in out.stdout.strip().splitlines() if l.startswith("{")][-1]
+    return json.loads(last)
+
+
+@pytest.mark.parametrize("script,args", CASES, ids=[c[0] for c in CASES])
+def test_example_smoke(script, args):
+    summary = run_example(script, args)
+    assert summary["rounds"] >= 1
+    assert "final" in summary
+    assert all(np.isfinite(v) for v in summary["final"].values()), summary

@@ -393,3 +393,27 @@ class TestKMeansHandler:
         matched = hm.merge(st, peer)
         np.testing.assert_allclose(np.asarray(matched.params), np.asarray(c1),
                                    atol=0.1)
+
+
+class TestMixedPrecision:
+    def test_bf16_compute_learns_params_stay_f32(self, key):
+        import optax
+        import jax.numpy as jnp
+        from gossipy_tpu.handlers import SGDHandler, losses
+        from gossipy_tpu.models import MLP
+        rng = np.random.default_rng(0)
+        d = 8
+        w = rng.normal(size=d)
+        X = rng.normal(size=(256, d)).astype(np.float32)
+        y = (X @ w > 0).astype(np.int64)
+        mask = np.ones(256, dtype=np.float32)
+        h = SGDHandler(model=MLP(d, 2, hidden_dims=(16,)),
+                       loss=losses.cross_entropy, optimizer=optax.sgd(0.2),
+                       local_epochs=5, batch_size=32, n_classes=2,
+                       input_shape=(d,), compute_dtype=jnp.bfloat16)
+        st = h.init(key)
+        st = jax.jit(h.update)(st, (X, y, mask), key)
+        leaves = jax.tree_util.tree_leaves(st.params)
+        assert all(l.dtype == jnp.float32 for l in leaves)
+        acc = h.evaluate(st, (X, y, mask))["accuracy"]
+        assert float(acc) > 0.9, float(acc)
