@@ -455,9 +455,18 @@ class GossipSimulator(SimulationEventSender):
             carries_model = (ty == MessageType.PUSH) | \
                             (ty == MessageType.PUSH_PULL) | \
                             (ty == MessageType.REPLY)
-            state = self._receive_slot_apply(
-                state, sr, sender, extra, valid & carries_model,
-                self._round_key(base_key, r, _K_CALL * 101 + k))
+            apply_mask = valid & carries_model
+            call_key = self._round_key(base_key, r, _K_CALL * 101 + k)
+            # Higher slots are empty most rounds (at most ~1 push per
+            # receiver per round in the base protocol); a cond lets the
+            # compiled program skip the whole merge+train pass for an
+            # unoccupied slot at runtime instead of masking it out.
+            state = jax.lax.cond(
+                apply_mask.any(),
+                lambda st: self._receive_slot_apply(st, sr, sender, extra,
+                                                    apply_mask, call_key),
+                lambda st: st,
+                state)
 
             if self._replies_possible():
                 wants_reply = (ty == MessageType.PULL) | (ty == MessageType.PUSH_PULL)
@@ -524,10 +533,15 @@ class GossipSimulator(SimulationEventSender):
             occupied = sender >= 0
             valid = occupied & online
             n_failed += (occupied & ~online).sum()
-            state = self._receive_slot_apply(
-                state, state.reply_box.send_round[b, :, k], sender,
-                state.reply_box.extra[b, :, k], valid,
-                self._round_key(base_key, r, (_K_CALL + 53) * 101 + k))
+            sr_k = state.reply_box.send_round[b, :, k]
+            extra_k = state.reply_box.extra[b, :, k]
+            call_key = self._round_key(base_key, r, (_K_CALL + 53) * 101 + k)
+            state = jax.lax.cond(
+                valid.any(),
+                lambda st: self._receive_slot_apply(st, sr_k, sender, extra_k,
+                                                    valid, call_key),
+                lambda st: st,
+                state)
         state = state._replace(reply_box=state.reply_box.clear_cell(b))
         return state, n_failed
 

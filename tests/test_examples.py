@@ -18,6 +18,8 @@ CASES = [
     ("main_ormandi_2013.py", ["--nodes", "24", "--rounds", "2"]),
     ("main_danner_2023.py", ["--nodes", "12", "--rounds", "2"]),
     ("main_all2all.py", ["--nodes", "12", "--rounds", "2"]),
+    ("main_cifar10_100nodes.py",
+     ["--nodes", "4", "--rounds", "1", "--subsample", "400"]),
 ]
 
 
