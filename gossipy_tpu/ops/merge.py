@@ -17,8 +17,12 @@ block — the gathered peer copy is never materialized.
 
 Layout notes (pallas_guide.md): feature blocks of 512 lanes (multiple of the
 128-lane tile), scalar prefetch for the row indices and blend weights so the
-DMA source of each grid step is known before the body runs. Off-TPU the same
-kernel runs in interpreter mode (used by the CPU test mesh).
+DMA source of each grid step is known before the body runs. Rows are
+processed one per grid step; to satisfy the TPU tiling rule (second-to-last
+block dim must be 8-divisible OR equal the array dim) the operands carry a
+unit middle axis — ``[rows, 1, features]`` with ``(1, 1, block_f)`` blocks.
+Off-TPU the same kernel runs in interpreter mode (used by the CPU test
+mesh).
 """
 
 from __future__ import annotations
@@ -66,24 +70,26 @@ def _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret: bool,
         p = jnp.pad(p, ((0, 0), (0, pad)))
         h = jnp.pad(h, ((0, 0), (0, pad)))
     fp = f + pad
+    p3 = p.reshape(n, 1, fp)
+    h3 = h.reshape(h.shape[0], 1, fp)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(n, fp // block_f),
         in_specs=[
-            pl.BlockSpec((1, block_f), lambda i, j, s, w1, w2: (i, j)),
-            pl.BlockSpec((1, block_f), lambda i, j, s, w1, w2: (s[i], j)),
+            pl.BlockSpec((1, 1, block_f), lambda i, j, s, w1, w2: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_f), lambda i, j, s, w1, w2: (s[i], 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, block_f), lambda i, j, s, w1, w2: (i, j)),
+        out_specs=pl.BlockSpec((1, 1, block_f), lambda i, j, s, w1, w2: (i, 0, j)),
     )
     out = pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n, fp), p.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, 1, fp), p.dtype),
         interpret=interpret,
     )(idx.astype(jnp.int32), w_self.astype(p.dtype), w_peer.astype(p.dtype),
-      p, h)
-    return out[:, :f] if pad else out
+      p3, h3)
+    return out.reshape(n, fp)[:, :f] if pad else out.reshape(n, fp)
 
 
 def gather_merge_flat(p: jax.Array, h: jax.Array, idx: jax.Array,

@@ -93,7 +93,7 @@ def _node_axis_entry(mesh: Mesh, axis_name):
         return axis_name
     if len(mesh.axis_names) > 1:
         return tuple(mesh.axis_names)
-    return NODE_AXIS
+    return mesh.axis_names[0]
 
 
 def state_shardings(state: SimState, mesh: Mesh,

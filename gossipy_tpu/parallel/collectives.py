@@ -1,0 +1,171 @@
+"""Explicit ICI ring collectives for the gossip exchange.
+
+The default engine lets XLA choose the collectives implied by shardings
+(gathers along the sharded node axis become all-to-alls). This module is the
+*explicit* communication backend: ``shard_map`` + ``lax.ppermute`` ring
+schedules, the TPU-native analogue of what a hand-written NCCL/MPI backend
+would be in a GPU framework (the reference has no backend at all — its
+"network" is a Python loop, SURVEY.md §2.12).
+
+Two primitives:
+
+- :func:`ring_all_gather` — unidirectional ring gather: each device forwards
+  its chunk one ring position per hop; after ``d-1`` hops every device holds
+  the full array. One chunk in flight per device per hop.
+- :func:`ring_mixed_matmul` — the all-to-all mixing merge ``W @ P`` as a ring
+  matmul: each device keeps its row block of ``W`` and a rotating column
+  chunk of ``P``; per hop it multiplies the resident chunk into its
+  accumulator (MXU work) while the next chunk moves over ICI. The full
+  stacked parameter matrix is never materialized on any device — peak
+  per-device memory is ``N/d`` rows instead of ``N``.
+
+:func:`ring_mix_pytree` applies the ring matmul leafwise over a stacked
+params pytree; ``All2AllGossipSimulator(..., mesh=..., ring_mix=True)`` uses
+it for the Koloskova mixing step (reference node.py:833-843 merges via a
+Python loop per node; here the whole network's merge is ``d`` pipelined
+MXU+ICI steps).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import _node_axis_entry
+
+
+def _ring_perm(d: int):
+    """Send each shard to the previous ring position (i -> i-1 mod d), so
+    after ``s`` hops device ``m`` holds the chunk that started on device
+    ``(m + s) % d``."""
+    return [(i, (i - 1) % d) for i in range(d)]
+
+
+# Hop loops are Python-unrolled up to this ring size (lets XLA pipeline
+# compute against the next hop's ICI transfer); larger rings roll into a
+# fori_loop so program size stays O(1) in pod size.
+_UNROLL_MAX = 16
+
+
+def _ring_hops(d: int, axis_name, hop, init):
+    """Run ``d`` ring hops: ``carry = hop(s, carry, chunk)`` then rotate
+    ``chunk`` one position (the final rotation is skipped). ``init`` is
+    ``(carry0, chunk0)``; returns the final carry."""
+    perm = _ring_perm(d)
+    carry, chunk = init
+    if d <= _UNROLL_MAX:
+        for s in range(d):
+            carry = hop(s, carry, chunk)
+            if s != d - 1:
+                chunk = jax.lax.ppermute(chunk, axis_name, perm)
+        return carry
+
+    def body(s, val):
+        c, ch = val
+        return hop(s, c, ch), jax.lax.ppermute(ch, axis_name, perm)
+
+    # The loop carry must have a stable varying-axes type: the initial
+    # accumulator (a plain zeros, device-invariant) becomes device-varying
+    # after one hop, so mark it varying up front.
+    carry = jax.lax.pcast(carry, axis_name, to="varying")
+    carry, chunk = jax.lax.fori_loop(0, d - 1, body, (carry, chunk))
+    return hop(d - 1, carry, chunk)
+
+
+def _axis_size(mesh: Mesh, axis_name) -> int:
+    """Ring length: the mesh axis size, or the product over a tuple of axes
+    (a 2-D ``(dcn, nodes)`` mesh rings over the combined flattened axes —
+    collectives accept axis-name tuples, with ring positions in flattened
+    order)."""
+    names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    d = 1
+    for a in names:
+        d *= int(mesh.shape[a])
+    return d
+
+
+def ring_all_gather(x: jax.Array, mesh: Mesh,
+                    axis_name=None) -> jax.Array:
+    """All-gather ``x`` (sharded on its leading axis) via a ppermute ring.
+
+    Returns the full array, replicated. Equivalent to
+    ``jax.lax.all_gather`` but with an explicit ring schedule (one
+    neighbor-to-neighbor ICI transfer per hop). ``axis_name`` (a mesh axis
+    or tuple of axes) defaults to the mesh-derived node placement — all
+    axes combined on a multi-axis mesh, matching ``shard_state``.
+    """
+    axis_name = _node_axis_entry(mesh, axis_name)
+    d = _axis_size(mesh, axis_name)
+    n = x.shape[0]
+    assert n % d == 0, f"leading axis {n} not divisible by mesh axis {d}"
+    nl = n // d
+
+    # Every device assembles the identical full array, but replication via a
+    # ppermute ring is not statically inferable — skip the varying-axes check.
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=P(axis_name, *([None] * (x.ndim - 1))),
+             out_specs=P(*([None] * x.ndim)), check_vma=False)
+    def body(chunk):
+        me = jax.lax.axis_index(axis_name)
+
+        def hop(s, out, ch):
+            src = (me + s) % d
+            return jax.lax.dynamic_update_slice_in_dim(out, ch, src * nl, 0)
+
+        out0 = jnp.zeros((n,) + chunk.shape[1:], chunk.dtype)
+        return _ring_hops(d, axis_name, hop, (out0, chunk))
+
+    return body(x)
+
+
+def ring_mixed_matmul(w: jax.Array, x: jax.Array, mesh: Mesh,
+                      axis_name=None) -> jax.Array:
+    """``w @ x`` with ``x`` sharded on its leading (node) axis, as a ring
+    matmul: per hop each device contracts its resident ``[n_local]`` chunk of
+    senders against the matching column block of its ``W`` rows, then rotates
+    the chunk one ring position. Compute (MXU) and communication (ICI)
+    pipeline across hops; no device ever holds more than ``N/d`` sender rows.
+
+    ``w`` is ``[N, N]`` (receiver rows x sender columns); ``x`` is
+    ``[N, F]``. Result is ``[N, F]`` sharded like ``x``. ``axis_name``
+    defaults to the mesh-derived node placement (see
+    :func:`ring_all_gather`).
+    """
+    axis_name = _node_axis_entry(mesh, axis_name)
+    d = _axis_size(mesh, axis_name)
+    n, f = x.shape
+    assert w.shape == (n, n), f"mixing matrix {w.shape} vs {n} nodes"
+    assert n % d == 0, f"node axis {n} not divisible by mesh axis {d}"
+    nl = n // d
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(axis_name, None), P(axis_name, None)),
+             out_specs=P(axis_name, None))
+    def body(w_rows, chunk):
+        me = jax.lax.axis_index(axis_name)
+
+        def hop(s, acc, ch):
+            src = (me + s) % d
+            w_blk = jax.lax.dynamic_slice(w_rows, (0, src * nl), (nl, nl))
+            return acc + w_blk @ ch
+
+        acc0 = jnp.zeros((nl, f), jnp.promote_types(w_rows.dtype, chunk.dtype))
+        return _ring_hops(d, axis_name, hop, (acc0, chunk)).astype(x.dtype)
+
+    return body(w, x)
+
+
+def ring_mix_pytree(w: jax.Array, params, mesh: Mesh,
+                    axis_name=None):
+    """Leafwise :func:`ring_mixed_matmul` over a stacked ``[N, ...]`` params
+    pytree (the all-to-all mixing merge ``P' = W_eff @ P``)."""
+    def leaf(p):
+        n = p.shape[0]
+        flat = p.reshape(n, int(np.prod(p.shape[1:])) if p.ndim > 1 else 1)
+        return ring_mixed_matmul(w, flat, mesh, axis_name).reshape(p.shape)
+
+    return jax.tree.map(leaf, params)

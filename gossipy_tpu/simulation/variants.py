@@ -173,14 +173,33 @@ class All2AllGossipSimulator(GossipSimulator):
     row renormalization (the reference silently shrinks the average,
     node.py:841 with missing cache entries); message delays collapse to
     round granularity (a round's mix uses round-start snapshots).
+
+    With ``ring_mix=True`` (requires ``mesh``) the mixing matmul runs as an
+    explicit shard_map + ppermute ring schedule over the mesh's node axis
+    (:mod:`gossipy_tpu.parallel.collectives`) instead of a dense einsum whose
+    collectives XLA chooses: per-hop MXU work pipelines with ICI chunk
+    rotation and no device materializes the full stacked params.
     """
 
-    def __init__(self, *args, mixing: jax.Array, **kwargs):
+    def __init__(self, *args, mixing: jax.Array, mesh=None,
+                 ring_mix: bool = False, **kwargs):
         kwargs.setdefault("protocol", AntiEntropyProtocol.PUSH)
         super().__init__(*args, **kwargs)
         assert self.protocol == AntiEntropyProtocol.PUSH, \
             "All2AllNode only supports PUSH protocol."  # node.py:856-858
         self.mixing = jnp.asarray(mixing, dtype=jnp.float32)
+        self.mesh = mesh
+        self.ring_mix = bool(ring_mix)
+        if self.ring_mix:
+            assert mesh is not None, "ring_mix=True requires a mesh"
+            # Ring over the same axes the node dimension is sharded on — all
+            # mesh axes combined on a 2-D (dcn, nodes) mesh, matching
+            # parallel.shard_state's placement.
+            from ..parallel import _node_axis_entry
+            from ..parallel.collectives import _axis_size
+            self._ring_axis = _node_axis_entry(mesh, None)
+            assert self.n_nodes % _axis_size(mesh, self._ring_axis) == 0, \
+                "node count must divide the mesh's node axes for ring_mix"
 
     def _round(self, state: SimState, base_key: jax.Array):
         r = state.round
@@ -205,10 +224,19 @@ class All2AllGossipSimulator(GossipSimulator):
         n_failed = (adj & fires[None, :] & (drop | ~online[:, None])).sum()
         size = self._model_size(state.model.params)
 
-        # The mixing merge: one matmul per parameter leaf.
-        def mix_leaf(p):
-            flat = p.reshape(n, -1)
-            return (w_eff @ flat).reshape(p.shape)
+        # The mixing merge: one matmul per parameter leaf — dense einsum, or
+        # the explicit shard_map+ppermute ring schedule over the mesh.
+        if self.ring_mix:
+            from ..parallel.collectives import ring_mix_pytree
+
+            def mix_tree(params):
+                return ring_mix_pytree(w_eff, params, self.mesh,
+                                       self._ring_axis)
+        else:
+            def mix_tree(params):
+                return jax.tree.map(
+                    lambda p: (w_eff @ p.reshape(n, -1)).reshape(p.shape),
+                    params)
 
         received_any = (live & (self.mixing > 0)).any(axis=1)
         mode = self.handler.mode
@@ -217,9 +245,9 @@ class All2AllGossipSimulator(GossipSimulator):
             updated = jax.vmap(self.handler.update)(
                 state.model, self._local_data(), keys)
             model = updated
-            mixed = jax.tree.map(mix_leaf, model.params)
+            mixed = mix_tree(model.params)
         else:  # MERGE_UPDATE (the reference's supported path, handler.py:652-654)
-            mixed = jax.tree.map(mix_leaf, state.model.params)
+            mixed = mix_tree(state.model.params)
             model = state.model
         ages = jnp.where(live, model.n_updates[None, :], 0).max(axis=1)
         new_age = jnp.maximum(model.n_updates, ages)

@@ -1,0 +1,67 @@
+"""Core primitives: GlobalSettings singleton, LOG duplicate filter, set_seed.
+
+Parity targets: reference gossipy/__init__.py:37-131 (Singleton metaclass,
+GlobalSettings, DuplicateFilter + LOG, set_seed).
+"""
+
+import logging
+import random
+
+import jax
+import numpy as np
+
+from gossipy_tpu import DuplicateFilter, GlobalSettings, LOG, set_seed
+
+
+def test_global_settings_is_singleton():
+    a = GlobalSettings()
+    b = GlobalSettings()
+    assert a is b
+
+
+def test_global_settings_device_roundtrip():
+    gs = GlobalSettings()
+    prev = gs._platform
+    try:
+        gs.set_device("tpu")
+        assert gs.get_device() == "tpu"
+        gs.set_device(None)
+        # Falls back to the live backend (CPU under the test mesh).
+        assert gs.get_device() == jax.default_backend()
+    finally:
+        gs.set_device(prev)
+
+
+def test_duplicate_filter_suppresses_repeats():
+    f = DuplicateFilter()
+
+    def rec(msg):
+        return logging.LogRecord("t", logging.INFO, __file__, 1, msg, None, None)
+
+    assert f.filter(rec("hello"))
+    assert not f.filter(rec("hello"))
+    assert f.filter(rec("world"))
+
+
+def test_log_has_duplicate_filter():
+    assert any(isinstance(flt, DuplicateFilter) for flt in LOG.filters)
+
+
+def test_set_seed_reproducible():
+    k1 = set_seed(123)
+    host1 = (random.random(), float(np.random.standard_normal()))
+    k2 = set_seed(123)
+    host2 = (random.random(), float(np.random.standard_normal()))
+    assert host1 == host2
+    assert jax.numpy.array_equal(jax.random.key_data(k1),
+                                 jax.random.key_data(k2))
+    draws1 = jax.random.normal(k1, (4,))
+    draws2 = jax.random.normal(k2, (4,))
+    np.testing.assert_array_equal(np.asarray(draws1), np.asarray(draws2))
+
+
+def test_set_seed_distinct_seeds_differ():
+    ka = set_seed(1)
+    kb = set_seed(2)
+    assert not jax.numpy.array_equal(jax.random.key_data(ka),
+                                     jax.random.key_data(kb))
