@@ -161,11 +161,22 @@ def ring_mixed_matmul(w: jax.Array, x: jax.Array, mesh: Mesh,
 
 def ring_mix_pytree(w: jax.Array, params, mesh: Mesh,
                     axis_name=None):
-    """Leafwise :func:`ring_mixed_matmul` over a stacked ``[N, ...]`` params
-    pytree (the all-to-all mixing merge ``P' = W_eff @ P``)."""
-    def leaf(p):
-        n = p.shape[0]
-        flat = p.reshape(n, int(np.prod(p.shape[1:])) if p.ndim > 1 else 1)
-        return ring_mixed_matmul(w, flat, mesh, axis_name).reshape(p.shape)
+    """:func:`ring_mixed_matmul` over a stacked ``[N, ...]`` params pytree
+    (the all-to-all mixing merge ``P' = W_eff @ P``).
 
-    return jax.tree.map(leaf, params)
+    All leaves are flattened and concatenated into one ``[N, sum(F)]``
+    matrix so the whole pytree rides a single d-hop ring (per-leaf rings
+    would pay the hop latency once per leaf, with near-empty transfers for
+    small bias leaves), then split back and cast to each leaf's dtype.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    n = leaves[0].shape[0]
+    flats = [l.reshape(n, int(np.prod(l.shape[1:])) if l.ndim > 1 else 1)
+             for l in leaves]
+    widths = [f.shape[1] for f in flats]
+    cat = jnp.concatenate([f.astype(jnp.result_type(*flats)) for f in flats],
+                          axis=1)
+    mixed = ring_mixed_matmul(w, cat, mesh, axis_name)
+    splits = jnp.split(mixed, np.cumsum(widths)[:-1], axis=1)
+    out = [s.reshape(l.shape).astype(l.dtype) for s, l in zip(splits, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -113,6 +113,61 @@ def run_ours(X, y) -> float:
     return float(report.curves(local=False)["accuracy"][-1])
 
 
+def run_reference_pegasos(X, y) -> float:
+    """Reference Pegasos config (main_ormandi_2013.py:21-53 at small scale:
+    +/-1 labels, AdaLine weights, clique, PUSH, no faults)."""
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import PegasosHandler as RefPegasos
+    from gossipy.model.nn import AdaLine as RefAdaLine
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    y_pm = 2 * y - 1  # main_ormandi_2013.py:25
+    dh = RefCDH(torch.tensor(X), torch.tensor(y_pm, dtype=torch.float32),
+                test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = RefPegasos(net=RefAdaLine(D), learning_rate=0.01,
+                       create_model_mode=RefMode.UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+def run_ours_pegasos(X, y) -> float:
+    from gossipy_tpu.handlers import PegasosHandler
+    from gossipy_tpu.models import AdaLine
+
+    y_pm = (2 * y - 1).astype(np.float32)
+    dh = ClassificationDataHandler(X, y_pm, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = PegasosHandler(AdaLine(D), 0.01,
+                             create_model_mode=CreateModelMode.UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
+                          delta=20, protocol=AntiEntropyProtocol.PUSH)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
 class TestGoldenParity:
     def test_same_config_same_quality(self):
         try:
@@ -123,6 +178,19 @@ class TestGoldenParity:
         acc_ref = run_reference(X, y)
         acc_ours = run_ours(X, y)
         # Both sides must actually learn, and land in the same band.
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
+    def test_pegasos_same_quality(self):
+        """Ormandi-2013-style Pegasos SVM: reference vs ours on one config."""
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=1)
+        acc_ref = run_reference_pegasos(X, y)
+        acc_ours = run_ours_pegasos(X, y)
         assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
         assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
         assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
