@@ -161,6 +161,8 @@ def bench_reference(X, y) -> float:
 
 
 def main():
+    from gossipy_tpu import enable_compilation_cache
+    enable_compilation_cache()
     X, y = make_data()
     ours = bench_ours(X, y)
     try:

@@ -19,6 +19,12 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
+# Re-runs of the same config load compiled programs from the persistent
+# cache instead of recompiling (minutes for the CNN configs).
+from gossipy_tpu import enable_compilation_cache
+
+enable_compilation_cache()
+
 
 def make_parser(description: str, rounds: int, nodes: int | None = None,
                 with_plot: bool = True):

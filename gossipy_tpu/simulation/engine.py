@@ -437,17 +437,20 @@ class GossipSimulator(SimulationEventSender):
         b = r % D
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE), self.online_prob, (n,))
-
-        n_failed = jnp.int32(0)
-        n_sent_replies = jnp.int32(0)
-        reply_size_total = jnp.int32(0)
         size = self._model_size(state.model.params)
 
-        for k in range(self.K):
-            sender = state.mailbox.sender[b, :, k]
-            sr = state.mailbox.send_round[b, :, k]
-            ty = state.mailbox.msg_type[b, :, k]
-            extra = state.mailbox.extra[b, :, k]
+        # One fori_loop iteration per mailbox slot: the compiled program
+        # contains ONE copy of the merge+train graph regardless of K (an
+        # unrolled loop multiplies HLO size and compile time by K — minutes
+        # for CNN configs). Slot index k is TRACED: it feeds fold_in key
+        # derivation, dynamic slot reads, and the _post_receive_slot hook —
+        # subclass hooks must treat k as an array, not a Python int.
+        def slot_body(k, carry):
+            state, n_failed, n_sent_replies, reply_size_total = carry
+            sender = jnp.take(state.mailbox.sender[b], k, axis=1)
+            sr = jnp.take(state.mailbox.send_round[b], k, axis=1)
+            ty = jnp.take(state.mailbox.msg_type[b], k, axis=1)
+            extra = jnp.take(state.mailbox.extra[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
             n_failed += (occupied & ~online).sum()
@@ -494,6 +497,11 @@ class GossipSimulator(SimulationEventSender):
 
             state = self._post_receive_slot(state, valid, ty, sender, extra,
                                             base_key, r, k)
+            return state, n_failed, n_sent_replies, reply_size_total
+
+        state, n_failed, n_sent_replies, reply_size_total = jax.lax.fori_loop(
+            0, self.K, slot_body,
+            (state, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
 
         state = state._replace(mailbox=state.mailbox.clear_cell(b))
         state, ex_sent, ex_failed, ex_size = self._post_deliver(state, base_key, r)
@@ -502,7 +510,12 @@ class GossipSimulator(SimulationEventSender):
 
     def _post_receive_slot(self, state: SimState, valid, ty, sender, extra,
                            base_key, r, k) -> SimState:
-        """Hook after each mailbox slot is processed (token reactions...)."""
+        """Hook after each mailbox slot is processed (token reactions...).
+
+        ``k`` is the TRACED slot index (the deliver phase rolls slots into a
+        ``fori_loop``): use it in array arithmetic / ``fold_in``, never as a
+        Python int.
+        """
         return state
 
     def _post_deliver(self, state: SimState, base_key, r):
@@ -527,14 +540,14 @@ class GossipSimulator(SimulationEventSender):
         b = r % D
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE * 7 + 3), self.online_prob, (n,))
-        n_failed = jnp.int32(0)
-        for k in range(self.Kr):
-            sender = state.reply_box.sender[b, :, k]
+        def slot_body(k, carry):
+            state, n_failed = carry
+            sender = jnp.take(state.reply_box.sender[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
             n_failed += (occupied & ~online).sum()
-            sr_k = state.reply_box.send_round[b, :, k]
-            extra_k = state.reply_box.extra[b, :, k]
+            sr_k = jnp.take(state.reply_box.send_round[b], k, axis=1)
+            extra_k = jnp.take(state.reply_box.extra[b], k, axis=1)
             call_key = self._round_key(base_key, r, (_K_CALL + 53) * 101 + k)
             state = jax.lax.cond(
                 valid.any(),
@@ -542,6 +555,10 @@ class GossipSimulator(SimulationEventSender):
                                                     valid, call_key),
                 lambda st: st,
                 state)
+            return state, n_failed
+
+        state, n_failed = jax.lax.fori_loop(
+            0, self.Kr, slot_body, (state, jnp.int32(0)))
         state = state._replace(reply_box=state.reply_box.clear_cell(b))
         return state, n_failed
 
