@@ -28,9 +28,10 @@ Fidelity notes (documented divergences, SURVEY.md §7c):
 - Bulk-synchronous rounds: within a round every send snapshots the
   round-start model, while the reference's shuffled sequential loop lets a
   node forward a model it merged moments earlier in the same round.
-- A node fires at most once per round (async nodes with period < round_len
-  would fire more often in the reference; periods are drawn ~N(delta,
-  delta/10), making that rare).
+- Async nodes fire at every multiple of their period inside the round
+  window, capped at a static ``max_fires_per_round`` (default 2; periods are
+  drawn ~N(delta, delta/10), so more than two fires per round is a
+  pathological tail the reference's unbounded loop would allow).
 - Replies carry the replier's round-start snapshot rather than its
   just-updated model.
 - Mailboxes have a static per-round capacity of ``mailbox_slots`` messages
@@ -57,7 +58,7 @@ from .report import SimulationReport
 # Engine-internal derived tags stay below 9000; variant subclasses must use
 # tags >= 9000 to avoid stream collisions.
 _K_PHASE, _K_PEER, _K_DROP, _K_DELAY, _K_ONLINE, _K_CALL, _K_EXTRA, \
-    _K_REPLY_DELAY, _K_REPLY_DROP, _K_EVAL, _K_TOKEN = range(11)
+    _K_REPLY_DELAY, _K_REPLY_DROP, _K_EVAL, _K_TOKEN, _K_FIRE = range(12)
 
 PROTO_TO_MSG = {
     AntiEntropyProtocol.PUSH: MessageType.PUSH,
@@ -156,6 +157,11 @@ class GossipSimulator(SimulationEventSender):
         ~N(delta, delta/10) period (reference node.py:79,111-125).
     mailbox_slots, reply_slots : int
         Static per-(round, receiver) message capacity.
+    max_fires_per_round : int | None
+        Static cap on how many times an async node can fire inside one
+        round window (reference node.py:111-125 fires at every multiple of
+        the node's period). ``None`` = 1 for sync simulations (exact), 2
+        for async (covers periods ~N(delta, delta/10)).
     message_size : int | None
         Payload size in scalars for delay/size accounting; defaults to the
         handler's model parameter count.
@@ -181,7 +187,8 @@ class GossipSimulator(SimulationEventSender):
                  mailbox_slots: int = 4,
                  reply_slots: int = 2,
                  message_size: Optional[int] = None,
-                 fused_merge: bool = False):
+                 fused_merge: bool = False,
+                 max_fires_per_round: Optional[int] = None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         self.handler = handler
         self.topology = topology
@@ -195,6 +202,10 @@ class GossipSimulator(SimulationEventSender):
         self.sync = sync
         self.K = int(mailbox_slots)
         self.Kr = int(reply_slots)
+        if max_fires_per_round is None:
+            max_fires_per_round = 1 if sync else 2
+        self.F = int(max_fires_per_round)
+        assert self.F >= 1
 
         self.data = {k: jnp.asarray(v) for k, v in data.items()}
         self.has_local_test = "xte" in data
@@ -278,23 +289,28 @@ class GossipSimulator(SimulationEventSender):
     def _round_key(self, base_key: jax.Array, r: jax.Array, purpose: int):
         return jax.random.fold_in(jax.random.fold_in(base_key, r), purpose)
 
-    def _fire_mask(self, state: SimState, r: jax.Array):
-        """Which nodes send this round + their time offset within the round.
+    def _fire_mask(self, state: SimState, r: jax.Array, f: int = 0):
+        """Which nodes perform their ``f``-th send of this round + its time
+        offset within the round.
 
         Sync: every node fires once at its fixed offset (node.py:111-125).
-        Async: node fires iff a multiple of its period falls in this round's
-        [r*delta, (r+1)*delta) window. Note every async node fires at t=0 of
-        round 0 — faithful to the reference, whose time loop starts at t=0
-        (simul.py:384-389) where ``t % period == 0`` holds for all nodes.
+        Async: a node fires at EVERY multiple of its period inside the round
+        window [r*delta, (r+1)*delta) (capped at ``max_fires_per_round``
+        sub-fires). Note every async node fires at t=0 of round 0 — faithful
+        to the reference, whose time loop starts at t=0 (simul.py:384-389)
+        where ``t % period == 0`` holds for all nodes.
         """
         if self.sync:
+            if f > 0:
+                return jnp.zeros(self.n_nodes, dtype=bool), state.phase
             return jnp.ones(self.n_nodes, dtype=bool), state.phase
         period = state.phase
         lo = r * self.delta
         hi = (r + 1) * self.delta
         first = ((lo + period - 1) // period) * period  # first multiple >= lo
-        fires = first < hi
-        return fires, (first - lo).astype(jnp.int32)
+        t_f = first + f * period
+        fires = t_f < hi
+        return fires, jnp.clip(t_f - lo, 0, self.delta - 1).astype(jnp.int32)
 
     def _scatter_messages(self, box: Mailbox, active, dr, recv, sender_ids,
                           send_round, msg_type, extra, r, slots_cap):
@@ -345,32 +361,48 @@ class GossipSimulator(SimulationEventSender):
 
     def _send_phase(self, state: SimState, base_key, r):
         n = self.n_nodes
-        fires, offset = self._fire_mask(state, r)
-        peers = self._select_peers(state, base_key, r)
-        active = fires & (peers >= 0)
-        active, state = self._send_gate(state, active, peers, base_key, r)
-
-        dropped = jax.random.bernoulli(
-            self._round_key(base_key, r, _K_DROP), self.drop_prob, (n,))
         size = self._model_size(state.model.params)
         if self.protocol == AntiEntropyProtocol.PULL:
             size = 1  # PULL requests carry no model (core.py:163-164)
-        delays = self.delay.sample(self._round_key(base_key, r, _K_DELAY), (n,), size)
-        dr = (offset + delays) // self.delta
-
         msg_type = PROTO_TO_MSG[self.protocol]
-        extra = self._send_extra(self._round_key(base_key, r, _K_EXTRA), state)
 
-        n_sent = active.sum()
-        n_fail_drop = (active & dropped).sum()
-        live = active & ~dropped
-        box, n_overflow = self._scatter_messages(
-            state.mailbox, live, dr, peers, jnp.arange(n, dtype=jnp.int32),
-            jnp.broadcast_to(r.astype(jnp.int32), (n,)),
-            jnp.full((n,), int(msg_type), dtype=jnp.int32),
-            extra, r, self.K)
-        sent_size = n_sent * size
-        return state._replace(mailbox=box), n_sent, n_fail_drop + n_overflow, sent_size
+        n_sent = jnp.int32(0)
+        n_failed = jnp.int32(0)
+        # Sub-fires: async nodes whose period fits multiple times in the
+        # round window send once per multiple (all from the round-start
+        # snapshot). F is 1 for sync simulations, so f=0 reproduces the
+        # single-fire path with an unmodified PRNG stream.
+        for f in range(self.F):
+            def key_f(purpose):
+                k = self._round_key(base_key, r, purpose)
+                return jax.random.fold_in(k, f) if f > 0 else k
+
+            # Peer-selection/gate hooks derive their own purposes from a
+            # base key; sub-fires > 0 get a distinct base via _K_FIRE.
+            fire_base = base_key if f == 0 else key_f(_K_FIRE)
+            fires, offset = self._fire_mask(state, r, f)
+            peers = self._select_peers(state, fire_base, r)
+            active = fires & (peers >= 0)
+            active, state = self._send_gate(state, active, peers, fire_base, r)
+
+            dropped = jax.random.bernoulli(
+                key_f(_K_DROP), self.drop_prob, (n,))
+            delays = self.delay.sample(key_f(_K_DELAY), (n,), size)
+            dr = (offset + delays) // self.delta
+
+            extra = self._send_extra(key_f(_K_EXTRA), state)
+
+            n_sent += active.sum()
+            n_failed += (active & dropped).sum()
+            live = active & ~dropped
+            box, n_overflow = self._scatter_messages(
+                state.mailbox, live, dr, peers, jnp.arange(n, dtype=jnp.int32),
+                jnp.broadcast_to(r.astype(jnp.int32), (n,)),
+                jnp.full((n,), int(msg_type), dtype=jnp.int32),
+                extra, r, self.K)
+            n_failed += n_overflow
+            state = state._replace(mailbox=box)
+        return state, n_sent, n_failed, n_sent * size
 
     def _gather_peer(self, state: SimState, send_round, sender):
         """Fetch the snapshot a message carries: history[send_round % D][sender]."""

@@ -81,6 +81,28 @@ class TestMinimumSlice:
         assert report.sent_messages > 0
         assert np.isfinite(report.curves(local=False)["accuracy"][-1])
 
+    def test_async_fast_nodes_fire_per_period(self, key):
+        """A node whose period fits k times in the round window sends k
+        messages per round (reference node.py:111-125 fires at every
+        multiple of the period), up to the static cap."""
+        sim = make_sim(n_nodes=8, sync=False, delta=20,
+                       max_fires_per_round=4)
+        st = sim.init_nodes(key)
+        # Periods 10 and 5: 2 and 4 multiples per 20-tick round.
+        st = st._replace(phase=jnp.full((8,), 10, dtype=jnp.int32))
+        _, rep2 = sim.start(st, n_rounds=4, key=jax.random.fold_in(key, 1))
+        assert rep2.sent_messages == 4 * 8 * 2, rep2.sent_messages
+        st = st._replace(phase=jnp.full((8,), 5, dtype=jnp.int32))
+        _, rep4 = sim.start(st, n_rounds=4, key=jax.random.fold_in(key, 1))
+        assert rep4.sent_messages == 4 * 8 * 4, rep4.sent_messages
+        # The cap truncates: period 5 with cap 1 = one send per round.
+        sim1 = make_sim(n_nodes=8, sync=False, delta=20,
+                        max_fires_per_round=1)
+        st1 = sim1.init_nodes(key)
+        st1 = st1._replace(phase=jnp.full((8,), 5, dtype=jnp.int32))
+        _, rep1 = sim1.start(st1, n_rounds=4, key=jax.random.fold_in(key, 1))
+        assert rep1.sent_messages == 4 * 8, rep1.sent_messages
+
 
 class TestSGDGossip:
     def make_handler(self, d=10, mode=CreateModelMode.MERGE_UPDATE):
