@@ -62,12 +62,30 @@ class SimulationReport:
         """[(round, {metric: mean})] — API parity with reference simul.py:262-266."""
         return self._to_rounds(self._local if local else self._global)
 
-    def curves(self, local: bool = True) -> dict[str, np.ndarray]:
-        """{metric: [R] array} convenience view for plotting/benchmarks."""
+    def curves(self, local: bool = True,
+               drop_nan: bool = True) -> dict[str, np.ndarray]:
+        """{metric: array} convenience view for plotting/benchmarks.
+
+        ``drop_nan=True`` (default) removes rounds where no evaluation ran
+        (``eval_every > 1`` skips), so ``curves(...)["accuracy"][-1]`` is
+        always the LAST EVALUATED value; the matching round numbers are
+        ``eval_rounds(local)``. Pass ``drop_nan=False`` for row-per-round
+        arrays aligned with ``sent_per_round``.
+        """
         arr = self._local if local else self._global
         if arr is None:
             return {}
+        if drop_nan:
+            keep = ~np.all(np.isnan(arr), axis=1)
+            arr = arr[keep]
         return {k: arr[:, i] for i, k in enumerate(self.metric_names)}
+
+    def eval_rounds(self, local: bool = True) -> np.ndarray:
+        """1-based round numbers where evaluation ran (rows of ``curves``)."""
+        arr = self._local if local else self._global
+        if arr is None:
+            return np.zeros((0,), dtype=int)
+        return np.nonzero(~np.all(np.isnan(arr), axis=1))[0] + 1
 
     def final(self, metric: str, local: bool = False) -> float:
         arr = self._local if local else self._global

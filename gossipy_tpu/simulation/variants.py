@@ -201,7 +201,7 @@ class All2AllGossipSimulator(GossipSimulator):
             assert self.n_nodes % _axis_size(mesh, self._ring_axis) == 0, \
                 "node count must divide the mesh's node axes for ring_mix"
 
-    def _round(self, state: SimState, base_key: jax.Array):
+    def _round(self, state: SimState, base_key: jax.Array, last_round=None):
         r = state.round
         state = self._snapshot(state, r)
         n = self.n_nodes
@@ -262,7 +262,7 @@ class All2AllGossipSimulator(GossipSimulator):
             model = select_nodes(fires, updated, model)
 
         state = state._replace(model=model)
-        local, glob = self._eval_phase(state, base_key, r)
+        local, glob = self._maybe_eval(state, base_key, r, last_round)
         state = state._replace(round=r + 1)
         stats = {
             "sent": n_sent,

@@ -81,6 +81,45 @@ class TestMinimumSlice:
         assert report.sent_messages > 0
         assert np.isfinite(report.curves(local=False)["accuracy"][-1])
 
+    def test_common_init(self, key):
+        """common_init=True starts every node from the same weights (pre
+        local training); default re-rolls per node as the reference does."""
+        handler = SGDHandler(model=LogisticRegression(10, 2),
+                             loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
+                             local_epochs=1, batch_size=8, n_classes=2,
+                             input_shape=(10,),
+                             create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = make_sim(signed=False, handler=handler)
+        st_c = sim.init_nodes(key, local_train=False, common_init=True)
+        leaves = jax.tree_util.tree_leaves(st_c.model.params)
+        for l in leaves:
+            np.testing.assert_array_equal(np.asarray(l[0]), np.asarray(l[1]))
+        st_d = sim.init_nodes(key, local_train=False)
+        assert any(not np.array_equal(np.asarray(l[0]), np.asarray(l[1]))
+                   for l in jax.tree_util.tree_leaves(st_d.model.params))
+
+    def test_eval_every(self, key):
+        """eval_every=3 evaluates rounds 3 and 6 only; other rounds are
+        omitted from the report (NaN rows dropped)."""
+        sim = make_sim(eval_every=3)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=6, key=jax.random.fold_in(key, 2))
+        rounds = [r for r, _ in report.get_evaluation(local=False)]
+        assert rounds == [3, 6], rounds
+        # The run's final round always evaluates even when off-cadence.
+        st7 = sim.init_nodes(key)
+        _, rep7 = sim.start(st7, n_rounds=7, key=jax.random.fold_in(key, 2))
+        assert [r for r, _ in rep7.get_evaluation(local=False)] == [3, 6, 7]
+        assert list(rep7.eval_rounds(local=False)) == [3, 6, 7]
+        assert len(rep7.curves(local=False)["accuracy"]) == 3  # NaN rows dropped
+        # Same simulation, same metrics at the evaluated rounds.
+        full = make_sim()
+        stf = full.init_nodes(key)
+        stf, rep_full = full.start(stf, n_rounds=6, key=jax.random.fold_in(key, 2))
+        acc_full = {r: m["accuracy"] for r, m in rep_full.get_evaluation(local=False)}
+        for r, m in report.get_evaluation(local=False):
+            np.testing.assert_allclose(m["accuracy"], acc_full[r], rtol=1e-6)
+
     def test_async_fast_nodes_fire_per_period(self, key):
         """A node whose period fits k times in the round window sends k
         messages per round (reference node.py:111-125 fires at every
