@@ -30,11 +30,12 @@ def make_dataset(n=400, d=10, seed=0, signed=False):
 
 
 def make_sim(n_nodes=16, protocol=AntiEntropyProtocol.PUSH, signed=True,
-             handler=None, delta=20, **sim_kwargs):
+             handler=None, delta=20, topo=None, **sim_kwargs):
     X, y = make_dataset(signed=signed)
     dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
     disp = DataDispatcher(dh, n=n_nodes)
-    topo = Topology.clique(n_nodes)
+    if topo is None:
+        topo = Topology.clique(n_nodes)
     if handler is None:
         handler = PegasosHandler(AdaLine(X.shape[1]), learning_rate=0.01,
                                  create_model_mode=CreateModelMode.UPDATE)
@@ -238,3 +239,27 @@ class TestMessageAccounting:
         # Requests cost 1, replies cost the model size: strictly less than
         # every message carrying a model.
         assert report.total_size < report.sent_messages * 10
+
+    def test_no_faults_no_failures(self, key):
+        """drop=0, online=1, zero delay, mailbox >= fan-in: every message
+        delivers (mailbox_slots sized to n-1 so overflow is impossible)."""
+        sim = make_sim(mailbox_slots=16)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=6)
+        assert report.failed_messages == 0
+
+    def test_mailbox_overflow_counts_failed(self, key):
+        """A star topology (everyone sends to node 0) with 1 mailbox slot:
+        per round, all but one incoming message overflows and is counted
+        failed — conservation of sent = delivered + failed."""
+        n = 8
+        adj = np.zeros((n, n), dtype=bool)
+        adj[1:, 0] = True  # spokes only know the hub
+        adj[0, 1] = True   # hub sends to node 1 (keeps every row nonempty)
+        sim = make_sim(n_nodes=n, topo=Topology(adj), mailbox_slots=1)
+        st = sim.init_nodes(key)
+        rounds = 5
+        st, report = sim.start(st, n_rounds=rounds, key=key)
+        assert report.sent_messages == rounds * n
+        # Node 0 receives n-1 messages/round into 1 slot -> n-2 overflow.
+        assert report.failed_messages == rounds * (n - 2)
