@@ -110,5 +110,12 @@ class GlobalSettings:
     def set_device(self, platform: str | None = None) -> None:
         self._platform = platform
 
+    def auto_device(self) -> str:
+        """Pick the best available backend (reference ``auto_device``
+        prefers CUDA over CPU, gossipy/__init__.py:57-66; here TPU > GPU >
+        CPU, which is what jax's default backend already resolves to)."""
+        self._platform = jax.default_backend()
+        return self._platform
+
     def get_device(self) -> str:
         return self._platform or jax.default_backend()

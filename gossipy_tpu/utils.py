@@ -175,6 +175,35 @@ def params_allclose(p1, p2, rtol: float = 1e-5, atol: float = 1e-7) -> bool:
                for a, b in zip(leaves1, leaves2))
 
 
+def download_and_unzip(url: str, extract_to: str = ".") -> list[str]:
+    """Download a zip archive and extract it (reference utils.py:98-122,
+    without the SSL-verification bypass fallback). Returns extracted names."""
+    import io
+    import urllib.request
+    import zipfile
+
+    with urllib.request.urlopen(url, timeout=30) as r:
+        data = r.read()
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        zf.extractall(extract_to)
+        return zf.namelist()
+
+
+def download_and_untar(url: str, extract_to: str = ".") -> list[str]:
+    """Download a tar(.gz) archive and extract it (reference utils.py:125-149,
+    without the SSL-verification bypass fallback). Returns extracted names."""
+    import io
+    import tarfile
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=30) as r:
+        data = r.read()
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        # filter="data" rejects path traversal / absolute members.
+        tf.extractall(extract_to, filter="data")
+        return tf.getnames()
+
+
 def plot_evaluation(evals: list[list[dict[str, float]]], title: str = "Untitled plot",
                     path: str | None = None):
     """Mean±std curves per metric (reference utils.py:152-183)."""

@@ -32,6 +32,42 @@ def test_global_settings_device_roundtrip():
         gs.set_device(prev)
 
 
+def test_auto_device():
+    gs = GlobalSettings()
+    prev = gs._platform
+    try:
+        assert gs.auto_device() == jax.default_backend()
+        assert gs.get_device() == jax.default_backend()
+    finally:
+        gs.set_device(prev)
+
+
+def test_download_helpers_roundtrip(tmp_path):
+    """download_and_unzip/untar extract archives served from a file:// URL
+    (reference utils.py:98-149; no egress needed)."""
+    import tarfile
+    import zipfile
+
+    src = tmp_path / "payload.txt"
+    src.write_text("hello")
+    zpath = tmp_path / "a.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.write(src, "payload.txt")
+    tpath = tmp_path / "a.tar.gz"
+    with tarfile.open(tpath, "w:gz") as tf:
+        tf.add(src, "payload.txt")
+
+    from gossipy_tpu.utils import download_and_untar, download_and_unzip
+    out1 = tmp_path / "out_zip"
+    names = download_and_unzip(zpath.as_uri(), str(out1))
+    assert names == ["payload.txt"]
+    assert (out1 / "payload.txt").read_text() == "hello"
+    out2 = tmp_path / "out_tar"
+    names = download_and_untar(tpath.as_uri(), str(out2))
+    assert "payload.txt" in names
+    assert (out2 / "payload.txt").read_text() == "hello"
+
+
 def test_duplicate_filter_suppresses_repeats():
     f = DuplicateFilter()
 

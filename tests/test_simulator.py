@@ -82,6 +82,23 @@ class TestMinimumSlice:
         assert report.sent_messages > 0
         assert np.isfinite(report.curves(local=False)["accuracy"][-1])
 
+    def test_interpreted_equals_jitted(self, key):
+        """SURVEY §4 test plan: the same seeds give the same round metrics
+        whether the round program runs compiled or op-by-op (guards the
+        scan/fori_loop rewrite against trace-vs-eager divergence)."""
+        run_key = jax.random.fold_in(key, 3)
+        sim = make_sim(n_nodes=8)
+        st = sim.init_nodes(key)
+        _, rep_jit = sim.start(st, n_rounds=3, key=run_key)
+        sim2 = make_sim(n_nodes=8)
+        st2 = sim2.init_nodes(key)
+        with jax.disable_jit():
+            _, rep_eager = sim2.start(st2, n_rounds=3, key=run_key)
+        np.testing.assert_allclose(rep_jit.curves(local=False)["accuracy"],
+                                   rep_eager.curves(local=False)["accuracy"],
+                                   rtol=1e-5)
+        assert rep_jit.sent_messages == rep_eager.sent_messages
+
     def test_common_init(self, key):
         """common_init=True starts every node from the same weights (pre
         local training); default re-rolls per node as the reference does."""
