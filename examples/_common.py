@@ -41,20 +41,37 @@ def make_parser(description: str, rounds: int, nodes: int | None = None,
     return p
 
 
+def add_repetitions_flag(p):
+    """Only for scripts that actually honor it (vmapped repetition batch)."""
+    p.add_argument("--repetitions", type=int, default=1,
+                   help="independent repetitions, run as ONE vmapped program")
+    return p
+
+
 def finish(report, args, local: bool = False, label: str = "final"):
-    """Print a one-line JSON summary + optionally save the plot."""
-    evals = report.get_evaluation(local)
+    """Print a one-line JSON summary + optionally save the plot.
+
+    ``report`` may be a single SimulationReport or a list of them (one per
+    repetition, e.g. from ``GossipSimulator.run_repetitions``): the summary
+    then reports the mean final metrics and the plot shows mean±std curves.
+    """
+    reports = report if isinstance(report, (list, tuple)) else [report]
+    evals_per_rep = [r.get_evaluation(local) for r in reports]
+    evals = evals_per_rep[0]
     summary = {
         "rounds": len(evals),
-        "sent_messages": report.sent_messages,
-        "failed_messages": report.failed_messages,
-        "total_size": report.total_size,
+        "repetitions": len(reports),
+        "sent_messages": sum(r.sent_messages for r in reports),
+        "failed_messages": sum(r.failed_messages for r in reports),
+        "total_size": sum(r.total_size for r in reports),
     }
     if evals:
-        summary[label] = {k: round(v, 4) for k, v in evals[-1][1].items()}
+        finals = [e[-1][1] for e in evals_per_rep if e]
+        summary[label] = {k: round(sum(f[k] for f in finals) / len(finals), 4)
+                          for k in finals[0]}
     print(json.dumps(summary))
     if args.plot:
         from gossipy_tpu.utils import plot_evaluation
-        plot_evaluation([[ev for _, ev in evals]],
+        plot_evaluation([[ev for _, ev in e] for e in evals_per_rep if e],
                         title=sys.argv[0], path=args.plot)
     return summary

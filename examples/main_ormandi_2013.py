@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from _common import make_parser, finish
+from _common import add_repetitions_flag, make_parser, finish
 
 from gossipy_tpu import set_seed
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology, UniformDelay
@@ -23,7 +23,8 @@ from gossipy_tpu.simulation import GossipSimulator
 
 
 def main():
-    args = make_parser(__doc__, rounds=100, nodes=0).parse_args()
+    args = add_repetitions_flag(
+        make_parser(__doc__, rounds=100, nodes=0)).parse_args()
     key = set_seed(args.seed)
 
     X, y = load_classification_dataset("spambase")
@@ -47,9 +48,17 @@ def main():
         sampling_eval=0.1,
         sync=False)
 
-    state = simulator.init_nodes(key)
-    state, report = simulator.start(state, n_rounds=args.rounds, key=key)
-    finish(report, args, local=False)
+    if args.repetitions > 1:
+        # All repetitions run as ONE vmapped XLA program (the reference
+        # loops whole Python simulations per seed).
+        import jax
+        _, reports = simulator.run_repetitions(
+            args.rounds, jax.random.split(key, args.repetitions))
+        finish(reports, args, local=False)
+    else:
+        state = simulator.init_nodes(key)
+        state, report = simulator.start(state, n_rounds=args.rounds, key=key)
+        finish(report, args, local=False)
 
 
 if __name__ == "__main__":

@@ -798,7 +798,10 @@ class GossipSimulator(SimulationEventSender):
         else:
             state, stats = self._jit_cache[cache_k](state, key)
         self.replay_events(first_round, stats, self._metric_keys())
-        report = SimulationReport(
+        return state, self._build_report(stats)
+
+    def _build_report(self, stats: dict) -> SimulationReport:
+        return SimulationReport(
             metric_names=self._metric_keys(),
             local_evals=np.asarray(stats["local"]) if self.has_local_test else None,
             global_evals=np.asarray(stats["global"]) if self.has_global_eval else None,
@@ -806,4 +809,46 @@ class GossipSimulator(SimulationEventSender):
             failed=np.asarray(stats["failed"]),
             total_size=int(np.asarray(stats["size"]).sum()),
         )
-        return state, report
+
+    def run_repetitions(self, n_rounds: int, keys: jax.Array,
+                        local_train: bool = True, common_init: bool = False,
+                        ) -> tuple[SimState, list[SimulationReport]]:
+        """Run S INDEPENDENT simulations — init + ``n_rounds`` rounds each —
+        as ONE compiled program, vmapped over a leading seed axis.
+
+        The reference runs experiment repetitions serially (one Python
+        simulation per seed); here the whole repetition batch executes in a
+        single XLA program whose per-node math is additionally batched over
+        seeds (MXU-friendly). This is what feeds
+        :func:`gossipy_tpu.utils.plot_evaluation`'s mean±std curves.
+
+        ``keys``: [S] stacked PRNG keys (e.g. ``jax.random.split(k, S)``).
+        Returns the stacked final states (leading seed axis) and one
+        :class:`SimulationReport` per seed. Event receivers are not
+        supported here (which repetition's events would they see?) — use
+        ``start`` per seed when you need the event stream.
+        """
+        assert not self._receivers_list(), \
+            "run_repetitions does not support event receivers; use start()"
+
+        cache_k = ("reps", n_rounds, bool(local_train), bool(common_init),
+                   self._cache_salt())
+        if cache_k not in self._jit_cache:
+            def one(key):
+                k_init, k_run = jax.random.split(key)
+                st = self.init_nodes(k_init, local_train=local_train,
+                                     common_init=common_init)
+                last = st.round + n_rounds - 1
+
+                def body(s, _):
+                    return self._round(s, k_run, last)
+
+                return jax.lax.scan(body, st, None, length=n_rounds)
+            self._jit_cache[cache_k] = jax.jit(jax.vmap(one))
+
+        states, stats = self._jit_cache[cache_k](keys)
+        host = jax.tree.map(np.asarray, stats)  # one device->host transfer
+        n_reps = host["sent"].shape[0]
+        reports = [self._build_report(jax.tree.map(lambda a, i=i: a[i], host))
+                   for i in range(n_reps)]
+        return states, reports

@@ -193,14 +193,22 @@ def download_and_untar(url: str, extract_to: str = ".") -> list[str]:
     """Download a tar(.gz) archive and extract it (reference utils.py:125-149,
     without the SSL-verification bypass fallback). Returns extracted names."""
     import io
+    import os
     import tarfile
     import urllib.request
 
     with urllib.request.urlopen(url, timeout=30) as r:
         data = r.read()
     with tarfile.open(fileobj=io.BytesIO(data)) as tf:
-        # filter="data" rejects path traversal / absolute members.
-        tf.extractall(extract_to, filter="data")
+        try:
+            # filter="data" rejects path traversal / absolute members.
+            tf.extractall(extract_to, filter="data")
+        except TypeError:  # Python < 3.10.12/3.11.4 lacks the filter kwarg
+            for m in tf.getmembers():
+                target = os.path.realpath(os.path.join(extract_to, m.name))
+                if not target.startswith(os.path.realpath(extract_to) + os.sep):
+                    raise ValueError(f"unsafe tar member: {m.name}")
+            tf.extractall(extract_to)
         return tf.getnames()
 
 

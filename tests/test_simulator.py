@@ -82,6 +82,26 @@ class TestMinimumSlice:
         assert report.sent_messages > 0
         assert np.isfinite(report.curves(local=False)["accuracy"][-1])
 
+    def test_run_repetitions_matches_serial(self, key):
+        """S vmapped repetitions produce the same per-seed results as S
+        serial init+start runs with the same key splits."""
+        sim = make_sim(n_nodes=8)
+        keys = jax.random.split(key, 3)
+        _, reports = sim.run_repetitions(4, keys)
+        assert len(reports) == 3
+        for i in range(3):
+            k_init, k_run = jax.random.split(keys[i])
+            sim_s = make_sim(n_nodes=8)
+            st = sim_s.init_nodes(k_init)
+            _, rep = sim_s.start(st, n_rounds=4, key=k_run)
+            np.testing.assert_allclose(
+                reports[i].curves(local=False)["accuracy"],
+                rep.curves(local=False)["accuracy"], rtol=1e-6)
+            assert reports[i].sent_messages == rep.sent_messages
+        # Different seeds actually differ (not one run broadcast S times).
+        assert (reports[0].curves(local=False)["accuracy"][0]
+                != reports[1].curves(local=False)["accuracy"][0])
+
     def test_interpreted_equals_jitted(self, key):
         """SURVEY §4 test plan: the same seeds give the same round metrics
         whether the round program runs compiled or op-by-op (guards the
