@@ -223,7 +223,28 @@ class LimitedMergeSGDHandler(SGDHandler):
                           jnp.maximum(state.n_updates, peer.n_updates))
 
 
-class SamplingSGDHandler(SGDHandler):
+class _PartialMergeCall:
+    """Receive-time dispatch for SUBSET-merge handlers.
+
+    ``SamplingTMH.__call__`` / ``PartitionedTMH.__call__`` (reference
+    handler.py:435-452, 478-494) differ from the base ``ModelHandler``
+    dispatch in UPDATE mode: the received model is trained on local data and
+    then only its subset (sample/partition) is merged into SELF — the local
+    model is never replaced wholesale (adopting it would defeat the
+    bandwidth-saving subset exchange). Other modes match the base dispatch.
+    """
+
+    def call(self, state: ModelState, peer: PeerModel, data, key: jax.Array,
+             extra=None) -> ModelState:
+        if self.mode == CreateModelMode.UPDATE:
+            recv_state = ModelState(peer.params, state.opt_state, peer.n_updates)
+            trained = self.update(recv_state, data, key)
+            return self.merge(state, PeerModel(trained.params, trained.n_updates),
+                              extra)
+        return super().call(state, peer, data, key, extra)
+
+
+class SamplingSGDHandler(_PartialMergeCall, SGDHandler):
     """Merge only a random coordinate subset (``SamplingTMH``, handler.py:426-452).
 
     ``extra`` is a PRNG key identifying the sample; both sides of an exchange
@@ -247,7 +268,7 @@ class SamplingSGDHandler(SGDHandler):
         return ModelState(params, state.opt_state, state.n_updates)
 
 
-class PartitionedSGDHandler(SGDHandler):
+class PartitionedSGDHandler(_PartialMergeCall, SGDHandler):
     """Partitioned model exchange (``PartitionedTMH``, handler.py:455-525).
 
     - ``n_updates`` is an int32 [n_parts] age vector (handler.py:475).

@@ -193,7 +193,6 @@ def download_and_untar(url: str, extract_to: str = ".") -> list[str]:
     """Download a tar(.gz) archive and extract it (reference utils.py:125-149,
     without the SSL-verification bypass fallback). Returns extracted names."""
     import io
-    import os
     import tarfile
     import urllib.request
 
@@ -201,14 +200,16 @@ def download_and_untar(url: str, extract_to: str = ".") -> list[str]:
         data = r.read()
     with tarfile.open(fileobj=io.BytesIO(data)) as tf:
         try:
-            # filter="data" rejects path traversal / absolute members.
+            # filter="data" rejects path traversal / absolute / link members.
             tf.extractall(extract_to, filter="data")
-        except TypeError:  # Python < 3.10.12/3.11.4 lacks the filter kwarg
-            for m in tf.getmembers():
-                target = os.path.realpath(os.path.join(extract_to, m.name))
-                if not target.startswith(os.path.realpath(extract_to) + os.sep):
-                    raise ValueError(f"unsafe tar member: {m.name}")
-            tf.extractall(extract_to)
+        except TypeError:
+            # Pre-PEP-706 interpreters (< 3.10.12 / 3.11.4) have no safe
+            # extraction filter; a hand-rolled name check cannot catch
+            # symlink-relative escapes, so refuse rather than extract
+            # unsafely.
+            raise RuntimeError(
+                "tar extraction needs a Python with the PEP 706 extraction "
+                "filter (>= 3.10.12 / 3.11.4); refusing unfiltered extractall")
         return tf.getnames()
 
 

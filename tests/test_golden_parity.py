@@ -168,6 +168,83 @@ def run_ours_pegasos(X, y) -> float:
     return float(report.curves(local=False)["accuracy"][-1])
 
 
+def run_reference_tokenized_partitioned(X, y) -> float:
+    """Reference Hegedus-2021 config at small scale: partitioned LogReg
+    exchange + randomized token accounts (main_hegedus_2021.py:28-69)."""
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.flow_control import RandomizedTokenAccount as RefRTA
+    from gossipy.model.handler import PartitionedTMH
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.model.sampling import TorchModelPartition
+    from gossipy.node import PartitioningBasedNode
+    from gossipy.simul import SimulationReport, TokenizedGossipSimulator as RefTGS
+
+    ref_seed(42)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    net = RefLogReg(D, 2)
+    proto = PartitionedTMH(
+        net=net, tm_partition=TorchModelPartition(net, 4),
+        optimizer=torch.optim.SGD,
+        optimizer_params={"lr": 1, "weight_decay": 0.001},
+        criterion=torch.nn.CrossEntropyLoss(),
+        create_model_mode=RefMode.UPDATE)
+    nodes = PartitioningBasedNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefTGS(nodes=nodes, data_dispatcher=disp,
+                 token_account=RefRTA(C=20, A=10),
+                 utility_fun=lambda mh1, mh2, msg: 1,
+                 delta=20, protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=TOKEN_ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+# Token accounts throttle early sends (the proactive ramp starts below
+# capacity), so this config needs more rounds than the plain ones to reach
+# a stable accuracy band.
+TOKEN_ROUNDS = 36
+
+
+def run_ours_tokenized_partitioned(X, y) -> float:
+    from gossipy_tpu.compression import ModelPartition
+    from gossipy_tpu.flow_control import RandomizedTokenAccount
+    from gossipy_tpu.handlers import PartitionedSGDHandler
+    from gossipy_tpu.simulation import TokenizedPartitioningGossipSimulator
+
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    model = LogisticRegression(D, 2)
+    template = model.init(jax.random.PRNGKey(0),
+                          jax.numpy.zeros((1, D)))["params"]
+    handler = PartitionedSGDHandler(
+        ModelPartition(template, 4), model=model, loss=losses.cross_entropy,
+        optimizer=optax.chain(optax.add_decayed_weights(0.001), optax.sgd(1.0)),
+        local_epochs=1, batch_size=8, n_classes=2, input_shape=(D,),
+        create_model_mode=CreateModelMode.UPDATE)
+    sim = TokenizedPartitioningGossipSimulator(
+        handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
+        protocol=AntiEntropyProtocol.PUSH,
+        token_account=RandomizedTokenAccount(C=20, A=10))
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=TOKEN_ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
 class TestGoldenParity:
     def test_same_config_same_quality(self):
         try:
@@ -181,6 +258,22 @@ class TestGoldenParity:
         assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
         assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
         assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
+    def test_tokenized_partitioned_same_quality(self):
+        """Hegedus-2021-style partitioned exchange + token accounts."""
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=2)
+        acc_ref = run_reference_tokenized_partitioned(X, y)
+        acc_ours = run_ours_tokenized_partitioned(X, y)
+        # The token ramp throttles early communication, so absolute accuracy
+        # at TOKEN_ROUNDS is modest on both sides; the contract is the same
+        # quality band, clearly above chance (0.5).
+        assert abs(acc_ours - acc_ref) < 0.12, (acc_ours, acc_ref)
+        assert acc_ref > 0.6, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.6, f"ours failed to learn: {acc_ours}"
 
     def test_pegasos_same_quality(self):
         """Ormandi-2013-style Pegasos SVM: reference vs ours on one config."""
