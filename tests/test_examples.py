@@ -44,3 +44,12 @@ def test_example_smoke(script, args):
     assert summary["rounds"] >= 1
     assert "final" in summary
     assert all(np.isfinite(v) for v in summary["final"].values()), summary
+
+
+def test_example_repetitions_smoke():
+    """--repetitions runs the vmapped batch and reports mean finals."""
+    summary = run_example("main_ormandi_2013.py",
+                          ["--nodes", "16", "--rounds", "2",
+                           "--repetitions", "3"])
+    assert summary["repetitions"] == 3
+    assert all(np.isfinite(v) for v in summary["final"].values()), summary
