@@ -11,9 +11,12 @@ within hosts and DCN across (jax.sharding semantics; cf. the public scaling
 book recipe: pick a mesh, annotate shardings, let XLA insert collectives).
 
 Model axes are left unsharded by default (gossip models are small); for a
-large model the ``PartitionSpec`` returned by :func:`state_shardings` can be
-extended with a ``model`` mesh axis on the parameter leaves (tensor
-parallelism) without touching the engine.
+large model, tensor parallelism is one mesh away: build a
+``(nodes, model)`` mesh with :func:`make_mesh_tp` and :func:`state_shardings`
+shards each parameter leaf's largest eligible non-node dimension over the
+``model`` axis — per-node matmuls then partition over the MXU across chips,
+with XLA inserting the contraction psums. The engine is untouched: shardings
+propagate from the input placement.
 """
 
 from __future__ import annotations
@@ -28,6 +31,7 @@ from ..simulation.engine import Mailbox, SimState
 
 NODE_AXIS = "nodes"
 DCN_AXIS = "dcn"
+MODEL_AXIS = "model"
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Mesh:
@@ -72,6 +76,33 @@ def make_mesh_2d(n_hosts: int, devices_per_host: Optional[int] = None,
     return Mesh(arr, axis_names)
 
 
+def make_mesh_tp(n_node_devices: int, n_model_devices: int,
+                 axis_names: tuple[str, str] = (NODE_AXIS, MODEL_AXIS)) -> Mesh:
+    """A 2-D ``(nodes, model)`` mesh: data parallelism over the node
+    population x tensor parallelism over model axes.
+
+    With this mesh, :func:`state_shardings` places the node dimension on the
+    ``nodes`` axis only and additionally shards each parameter leaf's largest
+    eligible non-node dimension over the ``model`` axis.
+    """
+    devs = jax.devices()
+    need = n_node_devices * n_model_devices
+    assert need <= len(devs), f"requested {need} devices, have {len(devs)}"
+    if jax.process_count() > 1:
+        # Plain device order is not host-contiguous across processes; a
+        # naive reshape could pair a model-axis group across DCN, putting
+        # every contraction psum on the slow links. Build the mesh
+        # explicitly (mesh_utils.create_hybrid_device_mesh with the model
+        # axis innermost) rather than silently degrading.
+        raise NotImplementedError(
+            "make_mesh_tp assumes single-process device order; on a "
+            "multi-host run build the Mesh from "
+            "mesh_utils.create_hybrid_device_mesh (model axis innermost) "
+            "and pass axis_names=('nodes', 'model')")
+    return Mesh(np.array(devs[:need]).reshape(n_node_devices, n_model_devices),
+                axis_names)
+
+
 def _spec_for_rank(lead_axis_pos: int, ndim: int, axis_name) -> P:
     """PartitionSpec placing ``axis_name`` (a mesh axis name or a tuple of
     them, for 2-D meshes) at position ``lead_axis_pos``."""
@@ -91,29 +122,74 @@ def _node_axis_entry(mesh: Mesh, axis_name):
     """
     if axis_name is not None:
         return axis_name
-    if len(mesh.axis_names) > 1:
-        return tuple(mesh.axis_names)
-    return mesh.axis_names[0]
+    # A "model" axis is tensor parallelism, never part of the node dimension.
+    names = tuple(a for a in mesh.axis_names if a != MODEL_AXIS)
+    assert names, "mesh has only a model axis; no axis left for nodes"
+    if len(names) > 1:
+        return names
+    return names[0]
+
+
+def _model_axis_entry(mesh: Mesh, model_axis):
+    """The mesh axis used for tensor parallelism, or None.
+
+    ``model_axis=None`` auto-detects: a mesh axis named ``"model"`` enables
+    TP; any other mesh is node-parallel only.
+    """
+    if model_axis is not None:
+        return model_axis
+    return MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+
+
+def _param_spec(leaf, node_pos: int, node_entry, mesh: Mesh, model_entry) -> P:
+    """PartitionSpec for a parameter leaf: node axis at ``node_pos``, plus —
+    when TP is on — the largest trailing dimension divisible by the model
+    axis size sharded over it (ties broken toward the last dimension, where
+    flax dense kernels put features)."""
+    dims: list = [None] * leaf.ndim
+    dims[node_pos] = node_entry
+    if model_entry is not None:
+        size = mesh.shape[model_entry]
+        cands = [i for i in range(node_pos + 1, leaf.ndim)
+                 if leaf.shape[i] >= size and leaf.shape[i] % size == 0]
+        if cands and size > 1:
+            dims[max(cands, key=lambda i: (leaf.shape[i], i))] = model_entry
+    return P(*dims)
 
 
 def state_shardings(state: SimState, mesh: Mesh,
-                    axis_name=None) -> SimState:
+                    axis_name=None, model_axis=None) -> SimState:
     """A SimState-shaped pytree of NamedShardings.
 
     - model / phase leaves: node axis leading -> ``P("nodes", ...)``
     - history / mailbox leaves: ``[D, N, ...]`` -> ``P(None, "nodes", ...)``
     - scalars (round counter): replicated
+    - on a TP mesh (an axis named ``"model"``, or ``model_axis=...``):
+      parameter, optimizer-state, and history-snapshot leaves additionally
+      shard their largest eligible non-node dimension over the model axis
     """
     entry = _node_axis_entry(mesh, axis_name)
+    model_entry = _model_axis_entry(mesh, model_axis)
 
     def shard(leaf, pos):
         if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
             return NamedSharding(mesh, P())
         return NamedSharding(mesh, _spec_for_rank(pos, leaf.ndim, entry))
 
-    model_sh = jax.tree.map(lambda l: shard(l, 0), state.model)
+    def shard_param(leaf, pos):
+        if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, _param_spec(leaf, pos, entry, mesh,
+                                               model_entry))
+
+    model_sh = state.model._replace(
+        params=jax.tree.map(lambda l: shard_param(l, 0), state.model.params),
+        opt_state=jax.tree.map(lambda l: shard_param(l, 0),
+                               state.model.opt_state),
+        n_updates=jax.tree.map(lambda l: shard(l, 0), state.model.n_updates),
+    )
     phase_sh = shard(state.phase, 0)
-    hist_p_sh = jax.tree.map(lambda l: shard(l, 1), state.history_params)
+    hist_p_sh = jax.tree.map(lambda l: shard_param(l, 1), state.history_params)
     hist_a_sh = shard(state.history_ages, 1)
     mb_sh = jax.tree.map(lambda l: shard(l, 1), state.mailbox)
     rb_sh = jax.tree.map(lambda l: shard(l, 1), state.reply_box)
@@ -126,9 +202,11 @@ def state_shardings(state: SimState, mesh: Mesh,
 
 
 def shard_state(state: SimState, mesh: Mesh,
-                axis_name=None) -> SimState:
-    """Place a SimState onto the mesh, node axis sharded."""
-    return jax.device_put(state, state_shardings(state, mesh, axis_name))
+                axis_name=None, model_axis=None) -> SimState:
+    """Place a SimState onto the mesh, node axis sharded (plus model axes on
+    a TP mesh)."""
+    return jax.device_put(state,
+                          state_shardings(state, mesh, axis_name, model_axis))
 
 
 def shard_data(data: dict, mesh: Mesh, axis_name=None) -> dict:

@@ -92,6 +92,28 @@ def test_2d_mesh_run_matches_unsharded(key):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_tp_mesh_run_matches_unsharded(key):
+    """(nodes, model) TP mesh: node axis DP x model-axis tensor parallelism."""
+    from gossipy_tpu.parallel import make_mesh_tp
+    sim, disp = build()
+    st = sim.init_nodes(key)
+    _, rep_plain = sim.start(st, n_rounds=3, key=jax.random.fold_in(key, 1))
+
+    mesh = make_mesh_tp(4, 2)
+    assert mesh.shape == {"nodes": 4, "model": 2}
+    sim_sh, _ = build(data=shard_data(disp.stacked(), mesh))
+    st_sh = shard_state(sim_sh.init_nodes(key), mesh)
+    # The MLP hidden kernel [N, 6, 8] must carry the model axis on its
+    # feature dimension; the node dimension stays on "nodes" alone.
+    kernel = st_sh.model.params["Dense_0"]["kernel"]
+    assert kernel.sharding.spec == ("nodes", None, "model")
+    assert len(kernel.sharding.device_set) == 8
+    _, rep_sh = sim_sh.start(st_sh, n_rounds=3, key=jax.random.fold_in(key, 1))
+    np.testing.assert_allclose(rep_plain.curves(local=False)["accuracy"],
+                               rep_sh.curves(local=False)["accuracy"],
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_sim_save_load_roundtrip(tmp_path, key):
     sim, _ = build()
     st = sim.init_nodes(key)
