@@ -69,14 +69,16 @@ def binary_auc(scores: jax.Array, y_true: jax.Array,
     mask = mask.astype(jnp.float32)
     pos = (y_true > 0).astype(jnp.float32) * mask
     neg = (y_true <= 0).astype(jnp.float32) * mask
-    # Midranks via one sort + associative scans (searchsorted's binary-search
-    # gathers are slow on TPU; this path is ~6x faster at [100, 920]).
+    # Midranks via ONE sort carrying the positive-indicator as a payload
+    # operand, then associative scans over the sorted array. The rank-sum of
+    # positives is order-independent, so no argsort index materialization,
+    # no gather, and no inverse scatter are needed — those two [N*E] ops
+    # were the single hottest fusions of the whole round program on TPU.
     # Masked entries are pushed to +inf: valid entries' ranks in the full
     # array then equal their ranks among valid entries alone.
     e = scores.shape[0]
     s = jnp.where(mask > 0, scores, jnp.inf)
-    order = jnp.argsort(s)
-    s_sorted = s[order]
+    s_sorted, pos_sorted = jax.lax.sort((s, pos), num_keys=1)
     idx = jnp.arange(e, dtype=jnp.float32)
     new_grp = jnp.concatenate([jnp.ones(1, bool), s_sorted[1:] != s_sorted[:-1]])
     grp_first = jax.lax.associative_scan(jnp.maximum, jnp.where(new_grp, idx, 0.0))
@@ -84,10 +86,9 @@ def binary_auc(scores: jax.Array, y_true: jax.Array,
     grp_last = jax.lax.associative_scan(
         jnp.minimum, jnp.where(end_grp, idx, float(e) - 1.0), reverse=True)
     midrank_sorted = (grp_first + grp_last) / 2.0 + 1.0  # 1-based average rank
-    midrank = jnp.zeros(e, jnp.float32).at[order].set(midrank_sorted)
     n_pos = pos.sum()
     n_neg = neg.sum()
-    rank_sum_pos = (midrank * pos).sum()
+    rank_sum_pos = (midrank_sorted * pos_sorted).sum()
     u = rank_sum_pos - n_pos * (n_pos + 1.0) / 2.0
     auc = _safe_div(u, n_pos * n_neg)
     return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.5)
