@@ -166,6 +166,18 @@ def rmse(pred: jax.Array, target: jax.Array, mask: jax.Array | None = None) -> j
     return jnp.sqrt(_safe_div((err2 * mask).sum(), mask.sum()))
 
 
+def choice_not_n(mn: int, mx: int, notn: int, key: jax.Array) -> jax.Array:
+    """A uniform random int in [mn, mx] excluding ``notn`` (reference
+    utils.py:41-64, which rejection-samples). Shift-based (draw from a range
+    one smaller and step over the excluded value), so it is jit-safe with no
+    data-dependent loop. The engine itself never needs this — peer sampling
+    masks self via the adjacency diagonal — it is provided for users porting
+    reference code."""
+    v = jax.random.randint(key, (), mn, mx)  # [mn, mx-1]
+    return jnp.where(v >= notn, v + 1, v) if mn <= notn <= mx else \
+        jax.random.randint(key, (), mn, mx + 1)
+
+
 def params_allclose(p1, p2, rtol: float = 1e-5, atol: float = 1e-7) -> bool:
     """Pytree parameter equality (replaces ``torch_models_eq``, reference utils.py:67-95)."""
     leaves1, tree1 = jax.tree_util.tree_flatten(p1)

@@ -101,3 +101,17 @@ def test_set_seed_distinct_seeds_differ():
     kb = set_seed(2)
     assert not jax.numpy.array_equal(jax.random.key_data(ka),
                                      jax.random.key_data(kb))
+
+
+def test_choice_not_n_excludes_and_covers():
+    from gossipy_tpu.utils import choice_not_n
+
+    seen = set()
+    for i in range(200):
+        v = int(choice_not_n(0, 5, 3, jax.random.PRNGKey(i)))
+        assert 0 <= v <= 5 and v != 3
+        seen.add(v)
+    assert seen == {0, 1, 2, 4, 5}
+    # Excluded value outside the range: plain uniform over [mn, mx].
+    vals = {int(choice_not_n(0, 2, 9, jax.random.PRNGKey(i))) for i in range(60)}
+    assert vals == {0, 1, 2}
