@@ -164,10 +164,65 @@ def bench_reference(X, y) -> float:
     return BASELINE_ROUNDS / elapsed
 
 
+def bench_to_accuracy(X, y, target: float) -> None:
+    """Secondary north-star: wall-clock to reach ``target`` global test
+    accuracy (BASELINE.json "wall-clock to target test-acc"), both sides on
+    the identical config. Not part of the driver's one-line contract; run
+    with ``python bench.py --to-acc 0.9``."""
+    import jax
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    dh = ClassificationDataHandler(X, y, test_size=0.2, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(X.shape[1], 2),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
+                         local_epochs=1, batch_size=32, n_classes=2,
+                         input_shape=(X.shape[1],),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = GossipSimulator(handler,
+                          Topology.random_regular(N_NODES, DEGREE, seed=42),
+                          disp.stacked(), delta=ROUND_LEN,
+                          protocol=AntiEntropyProtocol.PUSH)
+    key = jax.random.PRNGKey(42)
+    chunk = 20
+    state = sim.init_nodes(key)
+    s_warm, _ = sim.start(state, n_rounds=chunk, key=key)  # compile
+    jax.block_until_ready(s_warm.model.params)
+
+    state = sim.init_nodes(key)
+    t0 = time.perf_counter()
+    rounds_done, hit_at = 0, None
+    while rounds_done < 400 and hit_at is None:
+        state, report = sim.start(state, n_rounds=chunk, key=key)
+        accs = report.curves(local=False)["accuracy"]
+        for i, a in enumerate(accs):
+            if a >= target:
+                hit_at = rounds_done + i + 1
+                break
+        rounds_done += chunk
+    elapsed = time.perf_counter() - t0
+    if hit_at is None:
+        print(f"[to-acc] ours: target {target} NOT reached in "
+              f"{rounds_done} rounds ({elapsed:.2f}s)")
+    else:
+        print(f"[to-acc] ours: target {target} reached at round {hit_at} "
+              f"in {elapsed:.2f}s wall")
+
+
 def main():
     from gossipy_tpu import enable_compilation_cache
     enable_compilation_cache()
     X, y = make_data()
+    if "--to-acc" in sys.argv:
+        target = float(sys.argv[sys.argv.index("--to-acc") + 1])
+        bench_to_accuracy(X, y, target)
+        return
     ours = bench_ours(X, y)
     try:
         baseline = bench_reference(X, y)
