@@ -1,0 +1,238 @@
+"""Reference parity beyond the SGD configs: exact token-account formula
+equivalence and quality-band parity for the k-means and matrix-factorization
+handlers (the remaining handler families of SURVEY.md §2.5), each run
+through BOTH the reference implementation (imported from /root/reference)
+and gossipy_tpu on the same configuration.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from test_golden_parity import import_reference
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClusteringDataHandler, DataDispatcher, \
+    RecSysDataDispatcher, RecSysDataHandler
+from gossipy_tpu.flow_control import GeneralizedTokenAccount, \
+    PurelyProactiveTokenAccount, PurelyReactiveTokenAccount, \
+    RandomizedTokenAccount, SimpleTokenAccount
+from gossipy_tpu.handlers import KMeansHandler, MFHandler
+from gossipy_tpu.simulation import GossipSimulator
+
+
+class TestTokenAccountFormulas:
+    """Our vectorized policies vs the reference's per-object accounts,
+    exactly, over a grid of balances (reference flow_control.py:85-236)."""
+
+    BALANCES = list(range(0, 31))
+
+    def _pairs(self):
+        from gossipy.flow_control import (
+            GeneralizedTokenAccount as RefGTA,
+            PurelyProactiveTokenAccount as RefPPTA,
+            PurelyReactiveTokenAccount as RefPRTA,
+            RandomizedTokenAccount as RefRTA,
+            SimpleTokenAccount as RefSTA,
+        )
+        return [
+            (RefPPTA(), PurelyProactiveTokenAccount()),
+            (RefPRTA(k=3), PurelyReactiveTokenAccount(k=3)),
+            (RefSTA(C=5), SimpleTokenAccount(C=5)),
+            (RefGTA(C=20, A=10), GeneralizedTokenAccount(C=20, A=10)),
+            (RefRTA(C=20, A=10), RandomizedTokenAccount(C=20, A=10)),
+        ]
+
+    def test_proactive_exact(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        for ref, ours in self._pairs():
+            got = np.asarray(
+                ours.proactive(np.array(self.BALANCES, dtype=np.int32)))
+            for i, b in enumerate(self.BALANCES):
+                ref.n_tokens = b
+                assert got[i] == pytest.approx(float(ref.proactive())), \
+                    (type(ref).__name__, b, got[i])
+
+    def test_reactive_exact_deterministic(self):
+        """All deterministic reactive rules; for the randomized account the
+        balances that are exact multiples of A (zero rounding fraction)."""
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        key = jax.random.PRNGKey(0)
+        for ref, ours in self._pairs():
+            deterministic = not isinstance(ours, RandomizedTokenAccount)
+            for utility in (0, 1):
+                balances = self.BALANCES if deterministic else \
+                    [b for b in self.BALANCES if b % ours.A == 0]
+                got = np.asarray(ours.reactive(
+                    np.array(balances, dtype=np.int32),
+                    np.full(len(balances), utility, dtype=np.float32), key))
+                for i, b in enumerate(balances):
+                    ref.n_tokens = b
+                    assert int(got[i]) == int(ref.reactive(utility)), \
+                        (type(ref).__name__, b, utility, int(got[i]))
+
+    def test_randomized_reactive_rounding_statistics(self):
+        """randRound(a/A): mean over keys approximates the fraction."""
+        acct = RandomizedTokenAccount(C=20, A=10)
+        b = np.full((2000,), 13, dtype=np.int32)  # a/A = 1.3
+        u = np.ones((2000,), dtype=np.float32)
+        vals = np.asarray(acct.reactive(b, u, jax.random.PRNGKey(7)))
+        assert set(np.unique(vals)) <= {1, 2}
+        assert abs(vals.mean() - 1.3) < 0.05
+
+
+def blobs(n=240, d=2, seed=0):
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.5).astype(np.int64)
+    X = rng.normal(size=(n, d)).astype(np.float32) * 0.4 + \
+        np.where(y[:, None] > 0, 2.0, -2.0).astype(np.float32)
+    return X, y
+
+
+N_NODES = 12
+ROUNDS = 6
+
+
+def ref_kmeans_nmi(X, y) -> float:
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClusteringDataHandler as RefCluster
+    from gossipy.model.handler import KMeansHandler as RefKMeans
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    dh = RefCluster(torch.tensor(X), torch.tensor(y))
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = RefKMeans(k=2, dim=X.shape[1], alpha=0.1, matching="hungarian",
+                      create_model_mode=RefMode.MERGE_UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["nmi"])
+
+
+def our_kmeans_nmi(X, y) -> float:
+    dh = ClusteringDataHandler(X, y)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = KMeansHandler(k=2, dim=X.shape[1], alpha=0.1,
+                            matching="hungarian",
+                            create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
+                          delta=20, protocol=AntiEntropyProtocol.PUSH)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
+    return float(report.curves(local=False)["nmi"][-1])
+
+
+def synth_ratings(n_users=N_NODES, n_items=30, per_user=16, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_users, 3))
+    V = rng.normal(size=(n_items, 3))
+    ratings = {}
+    for u in range(n_users):
+        items = rng.choice(n_items, size=per_user, replace=False)
+        raw = U[u] @ V[items].T
+        r = np.clip(np.round(3 + raw), 1, 5).astype(np.float64)
+        ratings[u] = [(int(i), float(v)) for i, v in zip(items, r)]
+    return ratings, n_users, n_items
+
+
+def ref_mf_rmse(ratings, n_users, n_items) -> float:
+    import contextlib
+    import io
+
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import RecSysDataDispatcher as RefRecDisp
+    from gossipy.data.handler import RecSysDataHandler as RefRecDH
+    from gossipy.model.handler import MFModelHandler
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    dh = RefRecDH(ratings, n_users, n_items, 0.2, seed=42)
+    disp = RefRecDisp(dh)
+    disp.assign()
+    proto = MFModelHandler(dim=4, n_items=n_items, lam_reg=0.1,
+                           learning_rate=0.01,
+                           create_model_mode=RefMode.UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(n_users),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=ROUNDS)
+    return float(report.get_evaluation(True)[-1][1]["rmse"])
+
+
+def our_mf_rmse(ratings, n_users, n_items) -> float:
+    dh = RecSysDataHandler(ratings, n_users, n_items, test_size=0.2, seed=42)
+    disp = RecSysDataDispatcher(dh)
+    handler = MFHandler(dim=4, n_items=n_items, lam_reg=0.1,
+                        learning_rate=0.01,
+                        create_model_mode=CreateModelMode.UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(n_users), disp.stacked(),
+                          delta=20, protocol=AntiEntropyProtocol.PUSH)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
+    return float(report.curves(local=True)["rmse"][-1])
+
+
+class TestHandlerFamilies:
+    def test_kmeans_same_quality(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = blobs()
+        nmi_ref = ref_kmeans_nmi(X, y)
+        nmi_ours = our_kmeans_nmi(X, y)
+        # Well-separated blobs: both must recover the clusters.
+        assert nmi_ref > 0.7, f"reference failed to cluster: {nmi_ref}"
+        assert nmi_ours > 0.7, f"ours failed to cluster: {nmi_ours}"
+
+    def test_mf_same_quality(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        ratings, nu, ni = synth_ratings()
+        rmse_ref = ref_mf_rmse(ratings, nu, ni)
+        rmse_ours = our_mf_rmse(ratings, nu, ni)
+        # Both must beat the trivial constant-3 predictor (~1.3 RMSE on this
+        # rating distribution) and land in the same band. Ours trails the
+        # reference slightly at short horizons: bulk-synchronous rounds mix
+        # one round behind the reference's shuffled in-round propagation
+        # (documented divergence, SURVEY.md §7(c)).
+        assert rmse_ref < 1.25, f"reference failed to fit: {rmse_ref}"
+        assert rmse_ours < 1.25, f"ours failed to fit: {rmse_ours}"
+        assert abs(rmse_ours - rmse_ref) < 0.35, (rmse_ours, rmse_ref)
