@@ -207,7 +207,84 @@ def our_mf_rmse(ratings, n_users, n_items) -> float:
     return float(report.curves(local=True)["rmse"][-1])
 
 
+def ref_async_acc(X, y) -> float:
+    """Reference async-mode gossip (node.py:79,111-125: ~N(delta, delta/10)
+    per-node periods) on the LogReg config."""
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import TorchModelHandler
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = TorchModelHandler(
+        net=RefLogReg(X.shape[1], 2), optimizer=torch.optim.SGD,
+        optimizer_params={"lr": 0.5}, criterion=torch.nn.CrossEntropyLoss(),
+        local_epochs=1, batch_size=8,
+        create_model_mode=RefMode.MERGE_UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=False)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+def our_async_acc(X, y) -> float:
+    import optax
+
+    from gossipy_tpu.data import ClassificationDataHandler
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(X.shape[1], 2),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
+                         local_epochs=1, batch_size=8, n_classes=2,
+                         input_shape=(X.shape[1],),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
+                          delta=20, protocol=AntiEntropyProtocol.PUSH,
+                          sync=False)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
 class TestHandlerFamilies:
+    def test_async_same_quality(self):
+        """Async node periods (~N(delta, delta/10)); sub-fires are capped at
+        max_fires_per_round on our side (documented divergence)."""
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=3)
+        acc_ref = ref_async_acc(X, y)
+        acc_ours = our_async_acc(X, y)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
     def test_kmeans_same_quality(self):
         try:
             import_reference()
