@@ -11,6 +11,15 @@ import pytest
 
 from test_golden_parity import import_reference
 
+
+def _fresh_reference():
+    """Import the reference AND clear its global payload CACHE: it is a
+    process-wide singleton keyed by (node id, n_updates), so stale handlers
+    from a previous test's simulation would be popped by the next one."""
+    import_reference()
+    from gossipy import CACHE
+    CACHE.clear()
+
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
 from gossipy_tpu.data import ClusteringDataHandler, DataDispatcher, \
     RecSysDataDispatcher, RecSysDataHandler
@@ -45,7 +54,7 @@ class TestTokenAccountFormulas:
 
     def test_proactive_exact(self):
         try:
-            import_reference()
+            _fresh_reference()
         except Exception as e:  # pragma: no cover - env-specific
             pytest.skip(f"reference not importable: {e!r}")
         for ref, ours in self._pairs():
@@ -60,7 +69,7 @@ class TestTokenAccountFormulas:
         """All deterministic reactive rules; for the randomized account the
         balances that are exact multiples of A (zero rounding fraction)."""
         try:
-            import_reference()
+            _fresh_reference()
         except Exception as e:  # pragma: no cover - env-specific
             pytest.skip(f"reference not importable: {e!r}")
         key = jax.random.PRNGKey(0)
@@ -269,12 +278,176 @@ def our_async_acc(X, y) -> float:
     return float(report.curves(local=False)["accuracy"][-1])
 
 
+def ref_all2all_acc(X, y) -> float:
+    """Reference All2All mixing gossip (simul.py:720-852, node.py:789-870)."""
+    import contextlib
+    import io
+
+    import networkx as nx
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, \
+        CreateModelMode as RefMode, StaticP2PNetwork, UniformMixing
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import WeightedTMH
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import All2AllGossipNode
+    from gossipy.simul import All2AllGossipSimulator as RefA2A, SimulationReport
+
+    ref_seed(42)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    topo = StaticP2PNetwork(
+        N_NODES, nx.to_numpy_array(nx.random_regular_graph(4, N_NODES, seed=1)))
+    proto = WeightedTMH(
+        net=RefLogReg(X.shape[1], 2), optimizer=torch.optim.SGD,
+        optimizer_params={"lr": 0.1, "weight_decay": 0.01},
+        criterion=torch.nn.CrossEntropyLoss(),
+        create_model_mode=RefMode.MERGE_UPDATE)
+    nodes = All2AllGossipNode.generate(
+        data_dispatcher=disp, p2p_net=topo, model_proto=proto,
+        round_len=20, sync=True)
+    sim = RefA2A(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(UniformMixing(topo), n_rounds=A2A_ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+A2A_ROUNDS = 14
+
+
+def our_all2all_acc(X, y) -> float:
+    import optax
+
+    from gossipy_tpu.core import uniform_mixing
+    from gossipy_tpu.data import ClassificationDataHandler
+    from gossipy_tpu.handlers import WeightedSGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import All2AllGossipSimulator
+
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    topo = Topology.random_regular(N_NODES, 4, seed=1)
+    handler = WeightedSGDHandler(
+        model=LogisticRegression(X.shape[1], 2), loss=losses.cross_entropy,
+        optimizer=optax.chain(optax.add_decayed_weights(0.01), optax.sgd(0.1)),
+        local_epochs=1, batch_size=32, n_classes=2, input_shape=(X.shape[1],),
+        create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = All2AllGossipSimulator(handler, topo, disp.stacked(), delta=20,
+                                 mixing=uniform_mixing(topo))
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=A2A_ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
+def ref_pens_acc(X, y) -> float:
+    """Reference PENS two-phase peer selection (node.py:663-785) at small
+    scale with a LogReg handler."""
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import TorchModelHandler
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import PENSNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = TorchModelHandler(
+        net=RefLogReg(X.shape[1], 2), optimizer=torch.optim.SGD,
+        optimizer_params={"lr": 0.5}, criterion=torch.nn.CrossEntropyLoss(),
+        local_epochs=1, batch_size=8,
+        create_model_mode=RefMode.MERGE_UPDATE)
+    nodes = PENSNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True,
+        n_sampled=4, m_top=2, step1_rounds=3)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=PENS_ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+PENS_ROUNDS = 8
+
+
+def our_pens_acc(X, y) -> float:
+    import optax
+
+    from gossipy_tpu.data import ClassificationDataHandler
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import PENSGossipSimulator
+
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(X.shape[1], 2),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
+                         local_epochs=1, batch_size=8, n_classes=2,
+                         input_shape=(X.shape[1],),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = PENSGossipSimulator(handler, Topology.clique(N_NODES),
+                              disp.stacked(), delta=20,
+                              protocol=AntiEntropyProtocol.PUSH,
+                              n_sampled=4, m_top=2, step1_rounds=3)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=PENS_ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
 class TestHandlerFamilies:
+    def test_all2all_same_quality(self):
+        """Koloskova-style mixing gossip: reference vs ours on one config."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=4)
+        acc_ref = ref_all2all_acc(X, y)
+        acc_ours = our_all2all_acc(X, y)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
+    def test_pens_same_quality(self):
+        """PENS two-phase peer selection: reference vs ours on one config."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=5)
+        acc_ref = ref_pens_acc(X, y)
+        acc_ours = our_pens_acc(X, y)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
     def test_async_same_quality(self):
         """Async node periods (~N(delta, delta/10)); sub-fires are capped at
         max_fires_per_round on our side (documented divergence)."""
         try:
-            import_reference()
+            _fresh_reference()
         except Exception as e:  # pragma: no cover - env-specific
             pytest.skip(f"reference not importable: {e!r}")
         from test_golden_parity import make_dataset
@@ -287,7 +460,7 @@ class TestHandlerFamilies:
 
     def test_kmeans_same_quality(self):
         try:
-            import_reference()
+            _fresh_reference()
         except Exception as e:  # pragma: no cover - env-specific
             pytest.skip(f"reference not importable: {e!r}")
         X, y = blobs()
@@ -299,7 +472,7 @@ class TestHandlerFamilies:
 
     def test_mf_same_quality(self):
         try:
-            import_reference()
+            _fresh_reference()
         except Exception as e:  # pragma: no cover - env-specific
             pytest.skip(f"reference not importable: {e!r}")
         ratings, nu, ni = synth_ratings()
