@@ -549,7 +549,150 @@ def our_sampling_acc(X, y) -> float:
     return float(report.curves(local=False)["accuracy"][-1])
 
 
+def ref_adaline_acc(X, y) -> float:
+    """Reference AdaLineHandler delta rule (handler.py:337-391), ±1 labels."""
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import AdaLineHandler as RefAdaLineHandler
+    from gossipy.model.nn import AdaLine as RefAdaLine
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    y_pm = 2 * y - 1
+    dh = RefCDH(torch.tensor(X), torch.tensor(y_pm, dtype=torch.float32),
+                test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = RefAdaLineHandler(net=RefAdaLine(X.shape[1]), learning_rate=0.01,
+                              create_model_mode=RefMode.UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+def our_adaline_acc(X, y) -> float:
+    from gossipy_tpu.data import ClassificationDataHandler
+    from gossipy_tpu.handlers import AdaLineHandler
+    from gossipy_tpu.models import AdaLine
+
+    y_pm = (2 * y - 1).astype(np.float32)
+    dh = ClassificationDataHandler(X, y_pm, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = AdaLineHandler(AdaLine(X.shape[1]), 0.01,
+                             create_model_mode=CreateModelMode.UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
+                          delta=20, protocol=AntiEntropyProtocol.PUSH)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
+def ref_limitedmerge_acc(X, y) -> float:
+    """Reference LimitedMergeTMH (Danner 2023, handler.py:690-739)."""
+    import contextlib
+    import io
+
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import LimitedMergeTMH
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    ref_seed(42)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = LimitedMergeTMH(
+        net=RefLogReg(X.shape[1], 2), optimizer=torch.optim.SGD,
+        optimizer_params={"lr": 0.5}, criterion=torch.nn.CrossEntropyLoss(),
+        local_epochs=1, batch_size=8, age_diff_threshold=4,
+        create_model_mode=RefMode.MERGE_UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                 online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(n_rounds=ROUNDS)
+    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+
+
+def our_limitedmerge_acc(X, y) -> float:
+    import optax
+
+    from gossipy_tpu.data import ClassificationDataHandler
+    from gossipy_tpu.handlers import LimitedMergeSGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = LimitedMergeSGDHandler(
+        model=LogisticRegression(X.shape[1], 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8, n_classes=2,
+        input_shape=(X.shape[1],), age_diff_threshold=4,
+        create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
+                          delta=20, protocol=AntiEntropyProtocol.PUSH)
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
+    return float(report.curves(local=False)["accuracy"][-1])
+
+
 class TestHandlerFamilies:
+    def test_adaline_same_quality(self):
+        """Delta-rule AdaLine learner on ±1 labels."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=8)
+        acc_ref = ref_adaline_acc(X, y)
+        acc_ours = our_adaline_acc(X, y)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
+    def test_limitedmerge_same_quality(self):
+        """Danner 2023 age-gap-thresholded merging."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=9)
+        acc_ref = ref_limitedmerge_acc(X, y)
+        acc_ours = our_limitedmerge_acc(X, y)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
     def test_passthrough_same_quality(self):
         """Giaretta 2019 pass-through on a BA degree-skewed topology."""
         try:
