@@ -29,10 +29,10 @@ import numpy as np
 N_NODES = 100
 ROUND_LEN = 100
 # Steady-state measurement: enough rounds per executable call to amortize
-# the backend's fixed per-execution dispatch overhead (~65 ms on the
+# the backend's fixed per-execution dispatch overhead (~65+ ms on the
 # tunneled single-chip runtime — at 50 rounds/call that overhead alone
-# capped the measurement at ~130 r/s; the program itself runs ~1.5 ms/round).
-BENCH_ROUNDS = 500
+# capped the measurement at ~130 r/s; the program itself runs ~1.2 ms/round).
+BENCH_ROUNDS = 2000
 BASELINE_ROUNDS = 3
 DEGREE = 20
 # Reference rounds/s measured on this container's CPU (fallback when the
