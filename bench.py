@@ -50,8 +50,10 @@ def make_data():
     return X, y
 
 
-def bench_ours(X, y) -> float:
-    import jax
+def build_sim(X, y, fused: bool = False):
+    """The bench configuration (shared by the throughput and to-accuracy
+    modes): 100 nodes, LogReg SGD, MERGE_UPDATE, PUSH over a 20-regular
+    graph, per-round global eval."""
     import optax
 
     from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
@@ -67,13 +69,18 @@ def bench_ours(X, y) -> float:
                          local_epochs=1, batch_size=32, n_classes=2,
                          input_shape=(X.shape[1],),
                          create_model_mode=CreateModelMode.MERGE_UPDATE)
+    return GossipSimulator(handler,
+                           Topology.random_regular(N_NODES, DEGREE, seed=42),
+                           disp.stacked(), delta=ROUND_LEN,
+                           protocol=AntiEntropyProtocol.PUSH,
+                           fused_merge=fused)
+
+
+def bench_ours(X, y) -> float:
+    import jax
 
     def run(fused: bool) -> tuple[float, float]:
-        sim = GossipSimulator(handler,
-                              Topology.random_regular(N_NODES, DEGREE, seed=42),
-                              disp.stacked(), delta=ROUND_LEN,
-                              protocol=AntiEntropyProtocol.PUSH,
-                              fused_merge=fused)
+        sim = build_sim(X, y, fused)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
         # Warmup: trigger compilation of the scan.
@@ -170,25 +177,8 @@ def bench_to_accuracy(X, y, target: float) -> None:
     the identical config. Not part of the driver's one-line contract; run
     with ``python bench.py --to-acc 0.9``."""
     import jax
-    import optax
 
-    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
-    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
-    from gossipy_tpu.handlers import SGDHandler, losses
-    from gossipy_tpu.models import LogisticRegression
-    from gossipy_tpu.simulation import GossipSimulator
-
-    dh = ClassificationDataHandler(X, y, test_size=0.2, seed=42)
-    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
-    handler = SGDHandler(model=LogisticRegression(X.shape[1], 2),
-                         loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
-                         local_epochs=1, batch_size=32, n_classes=2,
-                         input_shape=(X.shape[1],),
-                         create_model_mode=CreateModelMode.MERGE_UPDATE)
-    sim = GossipSimulator(handler,
-                          Topology.random_regular(N_NODES, DEGREE, seed=42),
-                          disp.stacked(), delta=ROUND_LEN,
-                          protocol=AntiEntropyProtocol.PUSH)
+    sim = build_sim(X, y)
     key = jax.random.PRNGKey(42)
     chunk = 20
     state = sim.init_nodes(key)

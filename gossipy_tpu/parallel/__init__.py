@@ -171,16 +171,16 @@ def state_shardings(state: SimState, mesh: Mesh,
     entry = _node_axis_entry(mesh, axis_name)
     model_entry = _model_axis_entry(mesh, model_axis)
 
-    def shard(leaf, pos):
+    def _shard(leaf, pos, model):
         if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, _spec_for_rank(pos, leaf.ndim, entry))
+        return NamedSharding(mesh, _param_spec(leaf, pos, entry, mesh, model))
+
+    def shard(leaf, pos):
+        return _shard(leaf, pos, None)
 
     def shard_param(leaf, pos):
-        if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
-            return NamedSharding(mesh, P())
-        return NamedSharding(mesh, _param_spec(leaf, pos, entry, mesh,
-                                               model_entry))
+        return _shard(leaf, pos, model_entry)
 
     model_sh = state.model._replace(
         params=jax.tree.map(lambda l: shard_param(l, 0), state.model.params),

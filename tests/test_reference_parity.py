@@ -5,6 +5,9 @@ through BOTH the reference implementation (imported from /root/reference)
 and gossipy_tpu on the same configuration.
 """
 
+import contextlib
+import io
+
 import jax
 import numpy as np
 import pytest
@@ -28,6 +31,28 @@ from gossipy_tpu.flow_control import GeneralizedTokenAccount, \
     RandomizedTokenAccount, SimpleTokenAccount
 from gossipy_tpu.handlers import KMeansHandler, MFHandler
 from gossipy_tpu.simulation import GossipSimulator
+
+
+
+def _run_ref_sim(sim, rounds, metric="accuracy", local=False, start_args=()):
+    """Wire a reference simulator to a report, run it silenced, and return
+    the final mean of ``metric`` (the tail every ref_* config shares)."""
+    from gossipy.simul import SimulationReport
+
+    report = SimulationReport()
+    sim.add_receiver(report)
+    sim.init_nodes(seed=42)
+    with contextlib.redirect_stdout(io.StringIO()):
+        sim.start(*start_args, n_rounds=rounds)
+    return float(report.get_evaluation(local)[-1][1][metric])
+
+
+def _run_our_sim(sim, rounds, metric="accuracy", local=False):
+    """init_nodes + start + final metric, keyed identically across configs."""
+    key = jax.random.PRNGKey(42)
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=rounds, key=key)
+    return float(report.curves(local=local)[metric][-1])
 
 
 class TestTokenAccountFormulas:
@@ -109,9 +134,6 @@ ROUNDS = 6
 
 
 def ref_kmeans_nmi(X, y) -> float:
-    import contextlib
-    import io
-
     import torch
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
@@ -133,12 +155,7 @@ def ref_kmeans_nmi(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["nmi"])
+    return _run_ref_sim(sim, ROUNDS, metric="nmi")
 
 
 def our_kmeans_nmi(X, y) -> float:
@@ -149,10 +166,7 @@ def our_kmeans_nmi(X, y) -> float:
                             create_model_mode=CreateModelMode.MERGE_UPDATE)
     sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
                           delta=20, protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
-    return float(report.curves(local=False)["nmi"][-1])
+    return _run_our_sim(sim, ROUNDS, metric="nmi")
 
 
 def synth_ratings(n_users=N_NODES, n_items=30, per_user=16, seed=0):
@@ -169,9 +183,6 @@ def synth_ratings(n_users=N_NODES, n_items=30, per_user=16, seed=0):
 
 
 def ref_mf_rmse(ratings, n_users, n_items) -> float:
-    import contextlib
-    import io
-
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
         CreateModelMode as RefMode, StaticP2PNetwork
@@ -194,12 +205,7 @@ def ref_mf_rmse(ratings, n_users, n_items) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=ROUNDS)
-    return float(report.get_evaluation(True)[-1][1]["rmse"])
+    return _run_ref_sim(sim, ROUNDS, metric="rmse", local=True)
 
 
 def our_mf_rmse(ratings, n_users, n_items) -> float:
@@ -210,18 +216,12 @@ def our_mf_rmse(ratings, n_users, n_items) -> float:
                         create_model_mode=CreateModelMode.UPDATE)
     sim = GossipSimulator(handler, Topology.clique(n_users), disp.stacked(),
                           delta=20, protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
-    return float(report.curves(local=True)["rmse"][-1])
+    return _run_our_sim(sim, ROUNDS, metric="rmse", local=True)
 
 
 def ref_async_acc(X, y) -> float:
     """Reference async-mode gossip (node.py:79,111-125: ~N(delta, delta/10)
     per-node periods) on the LogReg config."""
-    import contextlib
-    import io
-
     import torch
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
@@ -247,12 +247,7 @@ def ref_async_acc(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, ROUNDS)
 
 
 def our_async_acc(X, y) -> float:
@@ -272,17 +267,11 @@ def our_async_acc(X, y) -> float:
     sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
                           delta=20, protocol=AntiEntropyProtocol.PUSH,
                           sync=False)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, ROUNDS)
 
 
 def ref_all2all_acc(X, y) -> float:
     """Reference All2All mixing gossip (simul.py:720-852, node.py:789-870)."""
-    import contextlib
-    import io
-
     import networkx as nx
     import torch
     from gossipy import set_seed as ref_seed
@@ -310,12 +299,7 @@ def ref_all2all_acc(X, y) -> float:
         round_len=20, sync=True)
     sim = RefA2A(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(UniformMixing(topo), n_rounds=A2A_ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, A2A_ROUNDS, start_args=(UniformMixing(topo),))
 
 
 A2A_ROUNDS = 14
@@ -340,18 +324,12 @@ def our_all2all_acc(X, y) -> float:
         create_model_mode=CreateModelMode.MERGE_UPDATE)
     sim = All2AllGossipSimulator(handler, topo, disp.stacked(), delta=20,
                                  mixing=uniform_mixing(topo))
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=A2A_ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, A2A_ROUNDS)
 
 
 def ref_pens_acc(X, y) -> float:
     """Reference PENS two-phase peer selection (node.py:663-785) at small
     scale with a LogReg handler."""
-    import contextlib
-    import io
-
     import torch
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
@@ -378,12 +356,7 @@ def ref_pens_acc(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=PENS_ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, PENS_ROUNDS)
 
 
 PENS_ROUNDS = 8
@@ -408,18 +381,12 @@ def our_pens_acc(X, y) -> float:
                               disp.stacked(), delta=20,
                               protocol=AntiEntropyProtocol.PUSH,
                               n_sampled=4, m_top=2, step1_rounds=3)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=PENS_ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, PENS_ROUNDS)
 
 
 def ref_passthrough_acc(X, y) -> float:
     """Reference PassThroughNode (Giaretta 2019, node.py:289-392) on a
     degree-skewed Barabasi-Albert topology."""
-    import contextlib
-    import io
-
     import networkx as nx
     import torch
     from gossipy import set_seed as ref_seed
@@ -448,12 +415,7 @@ def ref_passthrough_acc(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=PT_ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, PT_ROUNDS)
 
 
 # PASS adoptions (no training on the pass branch) slow convergence on the
@@ -480,17 +442,11 @@ def our_passthrough_acc(X, y) -> float:
     sim = PassThroughGossipSimulator(
         handler, Topology.barabasi_albert(N_NODES, 3, seed=1),
         disp.stacked(), delta=20, protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=PT_ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, PT_ROUNDS)
 
 
 def ref_sampling_acc(X, y) -> float:
     """Reference SamplingBasedNode + SamplingTMH (node.py:499-562)."""
-    import contextlib
-    import io
-
     import torch
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
@@ -517,12 +473,7 @@ def ref_sampling_acc(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, ROUNDS)
 
 
 def our_sampling_acc(X, y) -> float:
@@ -543,17 +494,11 @@ def our_sampling_acc(X, y) -> float:
     sim = SamplingGossipSimulator(handler, Topology.clique(N_NODES),
                                   disp.stacked(), delta=20,
                                   protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, ROUNDS)
 
 
 def ref_adaline_acc(X, y) -> float:
     """Reference AdaLineHandler delta rule (handler.py:337-391), ±1 labels."""
-    import contextlib
-    import io
-
     import torch
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
@@ -578,12 +523,7 @@ def ref_adaline_acc(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, ROUNDS)
 
 
 def our_adaline_acc(X, y) -> float:
@@ -598,17 +538,11 @@ def our_adaline_acc(X, y) -> float:
                              create_model_mode=CreateModelMode.UPDATE)
     sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
                           delta=20, protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, ROUNDS)
 
 
 def ref_limitedmerge_acc(X, y) -> float:
     """Reference LimitedMergeTMH (Danner 2023, handler.py:690-739)."""
-    import contextlib
-    import io
-
     import torch
     from gossipy import set_seed as ref_seed
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
@@ -634,12 +568,7 @@ def ref_limitedmerge_acc(X, y) -> float:
     sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
                  protocol=RefProto.PUSH, delay=ConstantDelay(0),
                  online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
-    report = SimulationReport()
-    sim.add_receiver(report)
-    sim.init_nodes(seed=42)
-    with contextlib.redirect_stdout(io.StringIO()):
-        sim.start(n_rounds=ROUNDS)
-    return float(report.get_evaluation(False)[-1][1]["accuracy"])
+    return _run_ref_sim(sim, ROUNDS)
 
 
 def our_limitedmerge_acc(X, y) -> float:
@@ -658,10 +587,7 @@ def our_limitedmerge_acc(X, y) -> float:
         create_model_mode=CreateModelMode.MERGE_UPDATE)
     sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
                           delta=20, protocol=AntiEntropyProtocol.PUSH)
-    key = jax.random.PRNGKey(42)
-    st = sim.init_nodes(key)
-    st, report = sim.start(st, n_rounds=ROUNDS, key=key)
-    return float(report.curves(local=False)["accuracy"][-1])
+    return _run_our_sim(sim, ROUNDS)
 
 
 class TestHandlerFamilies:
