@@ -590,7 +590,88 @@ def our_limitedmerge_acc(X, y) -> float:
     return _run_our_sim(sim, ROUNDS)
 
 
+def ref_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
+                rounds=ROUNDS) -> float:
+    """Reference vanilla SGD gossip with configurable protocol and faults."""
+    import torch
+    from gossipy import set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import TorchModelHandler
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim
+
+    ref_seed(42)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+    proto = TorchModelHandler(
+        net=RefLogReg(X.shape[1], 2), optimizer=torch.optim.SGD,
+        optimizer_params={"lr": 0.5}, criterion=torch.nn.CrossEntropyLoss(),
+        local_epochs=1, batch_size=8,
+        create_model_mode=RefMode.MERGE_UPDATE)
+    nodes = GossipNode.generate(
+        data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+        model_proto=proto, round_len=20, sync=True)
+    sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                 protocol=getattr(RefProto, protocol), delay=ConstantDelay(0),
+                 online_prob=online, drop_prob=drop, sampling_eval=0.0)
+    return _run_ref_sim(sim, rounds)
+
+
+def our_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
+                rounds=ROUNDS) -> float:
+    import optax
+
+    from gossipy_tpu.data import ClassificationDataHandler
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(X.shape[1], 2),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
+                         local_epochs=1, batch_size=8, n_classes=2,
+                         input_shape=(X.shape[1],),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
+                          delta=20,
+                          protocol=getattr(AntiEntropyProtocol, protocol),
+                          drop_prob=drop, online_prob=online)
+    return _run_our_sim(sim, rounds)
+
+
 class TestHandlerFamilies:
+    def test_push_pull_same_quality(self):
+        """PUSH_PULL replies (the second delivery phase) vs the reference."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=10)
+        acc_ref = ref_sgd_acc(X, y, protocol="PUSH_PULL")
+        acc_ours = our_sgd_acc(X, y, protocol="PUSH_PULL")
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
+    def test_faulty_network_same_quality(self):
+        """Message drop + node churn (Bernoulli gates both sides)."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=11)
+        acc_ref = ref_sgd_acc(X, y, drop=0.1, online=0.9, rounds=10)
+        acc_ours = our_sgd_acc(X, y, drop=0.1, online=0.9, rounds=10)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
     def test_adaline_same_quality(self):
         """Delta-rule AdaLine learner on ±1 labels."""
         try:
