@@ -173,9 +173,11 @@ def choice_not_n(mn: int, mx: int, notn: int, key: jax.Array) -> jax.Array:
     data-dependent loop. The engine itself never needs this — peer sampling
     masks self via the adjacency diagonal — it is provided for users porting
     reference code."""
+    if not mn <= notn <= mx:
+        return jax.random.randint(key, (), mn, mx + 1)
+    assert mn < mx, f"no value in [{mn}, {mx}] left after excluding {notn}"
     v = jax.random.randint(key, (), mn, mx)  # [mn, mx-1]
-    return jnp.where(v >= notn, v + 1, v) if mn <= notn <= mx else \
-        jax.random.randint(key, (), mn, mx + 1)
+    return jnp.where(v >= notn, v + 1, v)
 
 
 def params_allclose(p1, p2, rtol: float = 1e-5, atol: float = 1e-7) -> bool:
