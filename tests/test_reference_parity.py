@@ -121,6 +121,64 @@ class TestTokenAccountFormulas:
         assert abs(vals.mean() - 1.3) < 0.05
 
 
+class TestDelayFormulas:
+    """Delay models vs the reference (core.py:155-307): constant and linear
+    delays are deterministic — compare exactly; uniform compares the
+    inclusive range."""
+
+    def test_constant_and_linear_exact(self):
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from gossipy.core import ConstantDelay as RefConst, \
+            LinearDelay as RefLinear
+
+        from gossipy_tpu.core import ConstantDelay, LinearDelay
+
+        class Msg:  # the only part of Message a Delay reads
+            def __init__(self, size):
+                self._size = size
+
+            def get_size(self):
+                return self._size
+
+        key = jax.random.PRNGKey(0)
+        for d in (0, 1, 7):
+            ours = ConstantDelay(d).sample(key, (5,), size=123)
+            assert (np.asarray(ours) == RefConst(d).get(Msg(123))).all()
+        for timexunit, overhead in ((0, 3), (2, 1), (1, 0)):
+            ref = RefLinear(timexunit=timexunit, overhead=overhead)
+            ours_d = LinearDelay(timexunit, overhead)
+            for size in (1, 57, 1000):
+                ours = ours_d.sample(key, (4,), size=size)
+                assert (np.asarray(ours) == ref.get(Msg(size))).all(), \
+                    (timexunit, overhead, size)
+                assert ours_d.max_delay(size) == ref.get(Msg(size))
+
+    def test_uniform_range_matches(self):
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from gossipy.core import UniformDelay as RefUniform
+
+        from gossipy_tpu.core import UniformDelay
+
+        class Msg:
+            def get_size(self):
+                return 1
+
+        lo, hi = 2, 6
+        ref = RefUniform(lo, hi)
+        ref_draws = {ref.get(Msg()) for _ in range(300)}
+        ours = np.asarray(UniformDelay(lo, hi).sample(
+            jax.random.PRNGKey(1), (300,), size=1))
+        # Both are inclusive uniform over [lo, hi]: same support.
+        assert ref_draws == set(range(lo, hi + 1))
+        assert set(ours.tolist()) == set(range(lo, hi + 1))
+
+
 class TestAssignmentInvariants:
     """Structural invariants the non-IID assigners must share with the
     reference (data/__init__.py:164-373): both implementations are driven on
