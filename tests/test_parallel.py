@@ -124,3 +124,29 @@ def test_sim_save_load_roundtrip(tmp_path, key):
     for a, b in zip(jax.tree_util.tree_leaves(st),
                     jax.tree_util.tree_leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_into_tp_mesh(tmp_path, key):
+    """A checkpoint taken unsharded restores directly INTO a DP x TP mesh
+    (values unchanged, placement per state_shardings) and the run continues
+    with the same results as the unsharded continuation."""
+    from gossipy_tpu.parallel import make_mesh_tp
+    sim, disp = build()
+    st = sim.init_nodes(key)
+    st, _ = sim.start(st, n_rounds=2, key=key)
+    path = sim.save(str(tmp_path / "ck"), st, key=key)
+    _, rep_plain = sim.start(st, n_rounds=2, key=jax.random.fold_in(key, 9))
+
+    mesh = make_mesh_tp(4, 2)
+    sim_sh, _ = build(data=shard_data(disp.stacked(), mesh))
+    restored, _ = sim_sh.load(path, key, mesh=mesh)
+    kernel = restored.model.params["Dense_0"]["kernel"]
+    assert kernel.sharding.spec == ("nodes", None, "model")
+    for a, b in zip(jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _, rep_sh = sim_sh.start(restored, n_rounds=2,
+                             key=jax.random.fold_in(key, 9))
+    np.testing.assert_allclose(rep_plain.curves(local=False)["accuracy"],
+                               rep_sh.curves(local=False)["accuracy"],
+                               rtol=1e-4, atol=1e-5)
