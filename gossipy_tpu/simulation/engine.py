@@ -161,7 +161,11 @@ class GossipSimulator(SimulationEventSender):
         Sync nodes fire at a fixed offset each round; async nodes have a
         ~N(delta, delta/10) period (reference node.py:79,111-125).
     mailbox_slots, reply_slots : int
-        Static per-(round, receiver) message capacity.
+        Static per-(round, receiver) message capacity; overflow counts as
+        failed (the reference's Python lists are unbounded). The default 6
+        loses ~0.003% of messages under uniform peer selection at
+        degree-20 fan-in (vs ~0.3% at 4, ~4% more throughput); empty slots
+        are skipped at runtime, so unused capacity is cheap but not free.
     max_fires_per_round : int | None
         Static cap on how many times an async node can fire inside one
         round window (reference node.py:111-125 fires at every multiple of
@@ -190,7 +194,7 @@ class GossipSimulator(SimulationEventSender):
                  sampling_eval: float = 0.0,
                  eval_every: int = 1,
                  sync: bool = True,
-                 mailbox_slots: int = 4,
+                 mailbox_slots: int = 6,
                  reply_slots: int = 2,
                  message_size: Optional[int] = None,
                  fused_merge: bool = False,
