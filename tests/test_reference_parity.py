@@ -778,7 +778,7 @@ def our_limitedmerge_acc(X, y) -> float:
 
 
 def ref_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
-                rounds=ROUNDS) -> float:
+                rounds=ROUNDS, mode="MERGE_UPDATE") -> float:
     """Reference vanilla SGD gossip with configurable protocol and faults."""
     import torch
     from gossipy import set_seed as ref_seed
@@ -798,7 +798,7 @@ def ref_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
         net=RefLogReg(X.shape[1], 2), optimizer=torch.optim.SGD,
         optimizer_params={"lr": 0.5}, criterion=torch.nn.CrossEntropyLoss(),
         local_epochs=1, batch_size=8,
-        create_model_mode=RefMode.MERGE_UPDATE)
+        create_model_mode=getattr(RefMode, mode))
     nodes = GossipNode.generate(
         data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
         model_proto=proto, round_len=20, sync=True)
@@ -809,7 +809,7 @@ def ref_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
 
 
 def our_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
-                rounds=ROUNDS) -> float:
+                rounds=ROUNDS, mode="MERGE_UPDATE") -> float:
     import optax
 
     from gossipy_tpu.data import ClassificationDataHandler
@@ -822,7 +822,7 @@ def our_sgd_acc(X, y, protocol="PUSH", drop=0.0, online=1.0,
                          loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
                          local_epochs=1, batch_size=8, n_classes=2,
                          input_shape=(X.shape[1],),
-                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+                         create_model_mode=getattr(CreateModelMode, mode))
     sim = GossipSimulator(handler, Topology.clique(N_NODES), disp.stacked(),
                           delta=20,
                           protocol=getattr(AntiEntropyProtocol, protocol),
@@ -855,6 +855,20 @@ class TestHandlerFamilies:
         X, y = make_dataset(seed=11)
         acc_ref = ref_sgd_acc(X, y, drop=0.1, online=0.9, rounds=10)
         acc_ours = our_sgd_acc(X, y, drop=0.1, online=0.9, rounds=10)
+        assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
+        assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
+        assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
+
+    def test_update_merge_mode_same_quality(self):
+        """UPDATE_MERGE dispatch (train both models, then average)."""
+        try:
+            _fresh_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        from test_golden_parity import make_dataset
+        X, y = make_dataset(seed=12)
+        acc_ref = ref_sgd_acc(X, y, mode="UPDATE_MERGE")
+        acc_ours = our_sgd_acc(X, y, mode="UPDATE_MERGE")
         assert acc_ref > 0.8, f"reference failed to learn: {acc_ref}"
         assert acc_ours > 0.8, f"ours failed to learn: {acc_ours}"
         assert abs(acc_ours - acc_ref) < 0.1, (acc_ours, acc_ref)
