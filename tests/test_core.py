@@ -101,3 +101,21 @@ def test_mh_mixing_doubly_stochastic():
     assert np.allclose(w.sum(axis=0), 1.0, atol=1e-6)
     assert np.allclose(w, w.T)
     assert (np.diag(w) >= 0).all()
+
+
+def test_mixing_weight_rows_layout():
+    """Reference-layout per-node vectors: [self weight, peer weights...],
+    zero-padded to max degree (reference MixingMatrix.__getitem__)."""
+    import numpy as np
+
+    from gossipy_tpu.core import Topology, mixing_weight_rows, uniform_mixing
+
+    topo = Topology.ring(6, k=1)  # degree 2 everywhere
+    w = uniform_mixing(topo)
+    rows = np.asarray(mixing_weight_rows(w, topo))
+    assert rows.shape == (6, 3)
+    w_np = np.asarray(w)
+    for i in range(6):
+        peers = np.where(np.asarray(topo.adjacency)[i])[0]
+        assert rows[i, 0] == w_np[i, i]
+        np.testing.assert_allclose(rows[i, 1:1 + len(peers)], w_np[i, peers])
