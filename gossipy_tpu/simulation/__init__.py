@@ -2,6 +2,7 @@
 
 from .engine import GossipSimulator, Mailbox, SimState
 from .events import (
+    JSONLinesReceiver,
     ProgressReceiver,
     SimulationEventReceiver,
     SimulationEventSender,
@@ -28,4 +29,5 @@ __all__ = [
     "SamplingGossipSimulator", "PartitioningGossipSimulator",
     "PENSGossipSimulator",
     "SimulationEventReceiver", "SimulationEventSender", "ProgressReceiver",
+    "JSONLinesReceiver",
 ]

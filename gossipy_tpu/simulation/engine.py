@@ -67,6 +67,31 @@ PROTO_TO_MSG = {
 }
 
 
+_HOST_CALLBACKS_SUPPORTED: Optional[bool] = None
+
+
+def host_callbacks_supported() -> bool:
+    """Whether the active backend can run ``io_callback`` (probed once).
+
+    Some PJRT backends (e.g. the tunneled single-chip runtime) do not
+    implement host send/recv: unordered callbacks raise UNIMPLEMENTED and
+    ordered ones HANG — so live event receivers must fall back to post-run
+    replay there rather than deadlock the run.
+    """
+    global _HOST_CALLBACKS_SUPPORTED
+    if _HOST_CALLBACKS_SUPPORTED is None:
+        def probe(x):
+            jax.experimental.io_callback(lambda _: None, None, x,
+                                         ordered=False)
+            return x
+        try:
+            jax.block_until_ready(jax.jit(probe)(jnp.int32(0)))
+            _HOST_CALLBACKS_SUPPORTED = True
+        except Exception:
+            _HOST_CALLBACKS_SUPPORTED = False
+    return _HOST_CALLBACKS_SUPPORTED
+
+
 def select_nodes(mask: jax.Array, a, b):
     """Leafwise ``mask ? a : b`` where ``mask`` is a [N] node mask and the
     leaves carry a leading node axis (scalar leaves pass through unmasked
@@ -781,6 +806,14 @@ class GossipSimulator(SimulationEventSender):
             key = jax.random.PRNGKey(42)
 
         live = self.has_live_receivers()
+        live_fallback = live and not host_callbacks_supported()
+        if live_fallback:
+            import warnings
+            warnings.warn(
+                "this backend does not support host callbacks "
+                "(io_callback); live event receivers fall back to post-run "
+                "replay — all events still arrive, just not during the run")
+            live = False
         first_round = int(np.asarray(state.round))
         cache_k = ("start", n_rounds, self._cache_salt(), live)
         if cache_k not in self._jit_cache:
@@ -801,7 +834,8 @@ class GossipSimulator(SimulationEventSender):
                 jax.block_until_ready(state.model.params)
         else:
             state, stats = self._jit_cache[cache_k](state, key)
-        self.replay_events(first_round, stats, self._metric_keys())
+        self.replay_events(first_round, stats, self._metric_keys(),
+                           include_live=live_fallback)
         return state, self._build_report(stats)
 
     def _build_report(self, stats: dict) -> SimulationReport:

@@ -84,11 +84,12 @@ class SimulationEventSender:
 
     def _notify_round(self, round: int, sent: int, failed: int, size: int,
                       local: Optional[dict], glob: Optional[dict],
-                      live_only: bool = False) -> None:
+                      live_only: bool = False,
+                      include_live: bool = False) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
-            if not live_only and r.live:
+            if not live_only and r.live and not include_live:
                 continue  # live receivers already saw this round in-run
             r.update_message(round, sent, failed, size)
             if local is not None:
@@ -102,9 +103,12 @@ class SimulationEventSender:
             r.update_end()
 
     def replay_events(self, first_round: int, stats: dict,
-                      metric_names: list[str]) -> None:
+                      metric_names: list[str],
+                      include_live: bool = False) -> None:
         """Replay recorded per-round stats (host arrays) through non-live
-        receivers, then fire ``update_end``."""
+        receivers, then fire ``update_end``. ``include_live=True`` also
+        replays to live receivers — used when the backend cannot run host
+        callbacks and the in-run delivery was disabled."""
         if not self._receivers_list():
             return
         sent = np.asarray(stats["sent"])
@@ -122,7 +126,8 @@ class SimulationEventSender:
         for i in range(sent.shape[0]):
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
-                               row(local, i), row(glob, i))
+                               row(local, i), row(glob, i),
+                               include_live=include_live)
         self._notify_end()
 
 
@@ -146,3 +151,39 @@ class ProgressReceiver(SimulationEventReceiver):
             val = self._last.get(self.metric)
             extra = f" {self.metric}={val:.4f}" if val is not None else ""
             print(f"[round {round}]{extra}", flush=True)
+
+
+class JSONLinesReceiver(SimulationEventReceiver):
+    """Append one JSON object per round to a file — the metric-sink hook the
+    reference lists as an open TODO ("Weights and Biases support",
+    README.md:50), kept tool-agnostic: any dashboard can tail the .jsonl.
+
+    Each line: ``{"round": r, "sent": n, "failed": n, "size": n,
+    "local": {metric: mean} | null, "global": {...} | null}``.
+    Works replayed (default) or live (``live=True`` streams rows during the
+    jitted run through the ordered io_callback).
+    """
+
+    def __init__(self, path: str, live: bool = False):
+        import json
+        self._json = json
+        self.path = path
+        self.live = bool(live)
+        self._row: dict = {}
+        self._fh = open(path, "a", buffering=1)
+
+    def update_message(self, round, sent, failed, size):
+        self._row = {"round": round, "sent": sent, "failed": failed,
+                     "size": size, "local": None, "global": None}
+
+    def update_evaluation(self, round, on_user, metrics):
+        self._row["local" if on_user else "global"] = metrics
+
+    def update_timestep(self, round):
+        self._fh.write(self._json.dumps(self._row) + "\n")
+
+    def update_end(self):
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
