@@ -1,0 +1,75 @@
+"""Virtual-mesh environment provisioning (shared, jax-free).
+
+Multi-chip hardware is not attached in CI or under the driver; sharded code
+paths are proven on ``--xla_force_host_platform_device_count=N`` CPU devices —
+the same XLA partitioner and collectives as a real mesh. This module builds
+the child-process environment for that and is imported by both
+``tests/conftest.py`` (pytest re-exec) and ``__graft_entry__.py`` (driver
+dryrun subprocess). It must stay import-safe before jax initializes.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: Virtual device count used by the test suite's CPU mesh.
+TEST_DEVICE_COUNT = 8
+
+
+_FLAG_NAME = "--xla_force_host_platform_device_count"
+
+
+def host_device_flag(n_devices: int) -> str:
+    """The XLA flag forcing ``n_devices`` virtual CPU devices."""
+    return f"{_FLAG_NAME}={n_devices}"
+
+
+def provisioned_device_count(xla_flags: str) -> int | None:
+    """The virtual device count an ``XLA_FLAGS`` string provisions, if any.
+
+    Exact token parse (last occurrence wins, matching absl's duplicate-flag
+    resolution) — a substring test would false-match e.g. ``=80`` against
+    ``=8``.
+    """
+    count = None
+    for tok in xla_flags.split():
+        name, sep, val = tok.partition("=")
+        if name == _FLAG_NAME and sep:
+            try:
+                count = int(val)
+            except ValueError:
+                pass
+    return count
+
+
+def _is_tpu_plugin_entry(path: str) -> bool:
+    """True for PYTHONPATH entries that belong to the TPU-plugin sitecustomize.
+
+    The axon plugin registers a TPU backend at interpreter startup via a
+    sitecustomize hook (e.g. ``/root/.axon_site``). Match the path *component*
+    (not a bare substring) so unrelated paths that merely contain "axon"
+    survive.
+    """
+    return any(comp.startswith(".axon") or comp == "axon_site"
+               for comp in path.split(os.sep))
+
+
+def virtual_mesh_env(n_devices: int, base_env: dict | None = None,
+                     extra_path: str | None = None) -> dict:
+    """Environment for a child interpreter with ``n_devices`` virtual CPU devices.
+
+    Sets ``JAX_PLATFORMS=cpu``, appends the host-platform device-count flag to
+    ``XLA_FLAGS`` (appended last so it wins duplicate-flag resolution), and
+    strips TPU-plugin sitecustomize entries from PYTHONPATH so the child
+    starts clean on CPU. ``extra_path`` (e.g. the repo root) is prepended.
+    """
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = host_device_flag(n_devices)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flag).strip()
+    entries = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+               if p and not _is_tpu_plugin_entry(p)]
+    if extra_path:
+        entries.insert(0, extra_path)
+    env["PYTHONPATH"] = os.pathsep.join(entries)
+    return env

@@ -16,14 +16,20 @@ import sys
 
 import pytest
 
-_WANT_FLAG = "--xla_force_host_platform_device_count=8"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from _virtual_mesh import TEST_DEVICE_COUNT, provisioned_device_count, \
+    virtual_mesh_env  # noqa: E402 (jax-free; safe before re-exec)
 
 
 def _needs_reexec() -> bool:
     if os.environ.get("_GOSSIPY_TPU_TEST_REEXEC") == "1":
         return False
     return (os.environ.get("JAX_PLATFORMS") != "cpu"
-            or _WANT_FLAG not in os.environ.get("XLA_FLAGS", ""))
+            or provisioned_device_count(os.environ.get("XLA_FLAGS", ""))
+            != TEST_DEVICE_COUNT)
 
 
 _DO_REEXEC = _needs_reexec()
@@ -41,15 +47,8 @@ def pytest_configure(config):
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
+    env = virtual_mesh_env(TEST_DEVICE_COUNT)
     env["_GOSSIPY_TPU_TEST_REEXEC"] = "1"
-    # Drop TPU-plugin sitecustomize entries (e.g. .axon_site) so the child
-    # interpreter starts clean on CPU.
-    path_entries = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-                    if p and "axon" not in p]
-    env["PYTHONPATH"] = os.pathsep.join(path_entries)
     sys.stdout.flush()
     sys.stderr.flush()
     os.execve(sys.executable,
