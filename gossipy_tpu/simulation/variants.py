@@ -244,7 +244,9 @@ class All2AllGossipSimulator(GossipSimulator):
             keys = jax.random.split(self._round_key(base_key, r, _K_A2A_UPDATE), n)
             updated = jax.vmap(self.handler.update)(
                 state.model, self._local_data(), keys)
-            model = updated
+            # Only nodes that fired (timed out) train this round
+            # (node.py:833-843) — same gate as the MERGE_UPDATE branch.
+            model = select_nodes(fires, updated, state.model)
             mixed = mix_tree(model.params)
         else:  # MERGE_UPDATE (the reference's supported path, handler.py:652-654)
             mixed = mix_tree(state.model.params)

@@ -112,6 +112,44 @@ class TestAll2All:
         # Broadcast traffic: every node pushes to all its peers each round.
         assert rep.sent_messages == 10 * int(topo.degrees.sum())
 
+    def test_update_merge_only_fired_nodes_train(self, key):
+        """UPDATE_MERGE: a node that does not time out in a round must be
+        untouched that round (node.py:833-843). Async timing makes some
+        nodes skip rounds; identity mixing zeroes all peer weights, so
+        local training is the only channel that can change params."""
+        data, d = make_parts()
+        topo = Topology.clique(16)
+        handler = sgd_handler(d, mode=CreateModelMode.UPDATE_MERGE,
+                              cls=WeightedSGDHandler)
+        sim = All2AllGossipSimulator(handler, topo, data, delta=8,
+                                     sync=False, mixing=jnp.eye(16))
+        st = sim.init_nodes(key)
+        # Pin periods 6..13 so nodes with period > delta provably skip
+        # rounds (e.g. period 13 has no multiple in [16, 24)).
+        periods = 6 + np.arange(16) % 8
+        st = st._replace(phase=jnp.asarray(periods, dtype=st.phase.dtype))
+        n_nonfired_checked = 0
+        for _ in range(8):
+            r = int(st.round)
+            lo, hi = r * sim.delta, (r + 1) * sim.delta
+            first = -(-lo // periods) * periods  # first multiple >= lo
+            fires = first < hi
+            before = [np.asarray(l) for l in jax.tree.leaves(st.model.params)]
+            ages_before = np.asarray(st.model.n_updates)
+            st, _ = sim.start(st, n_rounds=1, key=jax.random.fold_in(key, r))
+            after = [np.asarray(l) for l in jax.tree.leaves(st.model.params)]
+            ages_after = np.asarray(st.model.n_updates)
+            changed = np.zeros(16, dtype=bool)
+            for b, a in zip(before, after):
+                changed |= (b != a).reshape(16, -1).any(axis=1)
+            assert not changed[~fires].any(), f"non-fired node trained at r={r}"
+            assert (ages_after[~fires] == ages_before[~fires]).all()
+            assert changed[fires].all(), f"fired node did not train at r={r}"
+            n_nonfired_checked += int((~fires).sum())
+        # The config must actually exercise the gate: some node must have
+        # skipped some round, or the assertions above were vacuous.
+        assert n_nonfired_checked > 0
+
     def test_mixing_shrinks_consensus_distance(self, key):
         """After mixing rounds, node models must be closer together than
         isolated training (the Koloskova consensus property)."""
