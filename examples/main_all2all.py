@@ -35,7 +35,7 @@ def main():
     data_handler = ClassificationDataHandler(X, y, test_size=0.1, seed=args.seed)
     n = args.nodes
     dispatcher = DataDispatcher(data_handler, n=n, eval_on_user=False)
-    topology = Topology.random_regular(n, min(20, n - 1), seed=42)
+    topology = Topology.random_regular(n, min(20, n - 1), seed=42, backend="networkx")
 
     handler = WeightedSGDHandler(
         model=LogisticRegression(data_handler.size(1), 2),

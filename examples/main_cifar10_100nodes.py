@@ -82,7 +82,7 @@ def main():
         compute_dtype=jnp.bfloat16 if args.bf16 else None)
 
     simulator = GossipSimulator(
-        handler, Topology.random_regular(n, min(20, n - 1), seed=42),
+        handler, Topology.random_regular(n, min(20, n - 1), seed=42, backend="networkx"),
         dispatcher.stacked(),
         delta=100, protocol=AntiEntropyProtocol.PUSH,
         sampling_eval=0.1, sync=True, eval_every=args.eval_every,

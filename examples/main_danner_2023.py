@@ -41,7 +41,7 @@ def main():
         create_model_mode=CreateModelMode.MERGE_UPDATE)
 
     simulator = GossipSimulator(
-        handler, Topology.random_regular(n, min(20, n - 1), seed=42),
+        handler, Topology.random_regular(n, min(20, n - 1), seed=42, backend="networkx"),
         dispatcher.stacked(),
         delta=100, protocol=AntiEntropyProtocol.PUSH,
         delay=UniformDelay(0, 10),

@@ -52,7 +52,7 @@ def main():
                              create_model_mode=CreateModelMode.MERGE_UPDATE)
 
     simulator = SIMULATORS[args.variant](
-        handler, Topology.barabasi_albert(n, m=min(10, n - 1), seed=args.seed),
+        handler, Topology.barabasi_albert(n, m=min(10, n - 1), seed=args.seed, backend="networkx"),
         dispatcher.stacked(),
         delta=100,
         protocol=AntiEntropyProtocol.PUSH,

@@ -42,7 +42,7 @@ def main():
                         create_model_mode=CreateModelMode.MERGE_UPDATE)
 
     simulator = GossipSimulator(
-        handler, Topology.random_regular(n_users, 20, seed=42),
+        handler, Topology.random_regular(n_users, 20, seed=42, backend="networkx"),
         dispatcher.stacked(),
         delta=100, protocol=AntiEntropyProtocol.PUSH,
         delay=UniformDelay(0, 10), sampling_eval=0.1, sync=True)

@@ -43,7 +43,7 @@ def main():
     data_handler = ClassificationDataHandler(X, y, test_size=0.1, seed=args.seed)
     n = args.nodes
     dispatcher = DataDispatcher(data_handler, n=n, eval_on_user=False)
-    topology = Topology.random_regular(n, min(20, n - 1), seed=42)
+    topology = Topology.random_regular(n, min(20, n - 1), seed=42, backend="networkx")
 
     model = LogisticRegression(data_handler.size(1), 2)
     optimizer = optax.chain(optax.add_decayed_weights(1e-3), optax.sgd(1.0))

@@ -113,7 +113,18 @@ class Topology:
         if backend == "native":
             assert native.available(), "native graphgen unavailable (no g++?)"
             return True
-        return n >= Topology.NATIVE_THRESHOLD and native.available()
+        if n >= Topology.NATIVE_THRESHOLD and native.available():
+            # Reproducibility foot-gun: crossing the threshold silently
+            # changes the generator's RNG stream, hence the experiment's
+            # edge set. Say so loudly; pin backend= to silence.
+            from . import LOG
+            LOG.warning(
+                "Topology backend='auto' selected the native generator for "
+                "n=%d (threshold %d): edge sets differ from networkx's RNG "
+                "stream. Pin backend='native' or backend='networkx' for "
+                "cross-size reproducibility.", n, Topology.NATIVE_THRESHOLD)
+            return True
+        return False
 
     @staticmethod
     def random_regular(n: int, degree: int, seed: int = 42,
@@ -183,6 +194,112 @@ def sample_peers(key: jax.Array, adjacency: jax.Array) -> jax.Array:
     peers = jax.random.categorical(key, logits, axis=-1)
     has_peer = adjacency.any(axis=-1)
     return jnp.where(has_peer, peers, -1).astype(jnp.int32)
+
+
+class SparseTopology:
+    """CSR neighbor-list topology for node counts where a dense [N, N]
+    adjacency no longer fits (~2.5 GB at 50k nodes).
+
+    Same query surface as :class:`Topology` (``num_nodes`` / ``degrees`` /
+    ``degrees_dev`` / ``get_peers`` / ``size`` / ``sample_peers``), so the
+    gossip engine runs unchanged; device memory is O(E): ``indices`` [2E]
+    neighbor ids grouped per node, ``indptr`` [N+1] row offsets.
+    ``sample_peers`` is a per-node ``randint(degree)`` into the neighbor
+    row — one [N] gather instead of an [N, N] categorical.
+
+    This breaks the scale wall the reference shares (its
+    ``StaticP2PNetwork``, core.py:311-361, is dense-only). Features that
+    inherently need the dense matrix (mixing matrices / All2All einsum)
+    remain with :class:`Topology`.
+    """
+
+    def __init__(self, num_nodes: int, edges: np.ndarray):
+        """``edges``: undirected edge list [E, 2] (each edge once, no
+        self-loops/duplicates — the generators guarantee this)."""
+        edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+        n = int(num_nodes)
+        src = np.concatenate([edges[:, 0], edges[:, 1]])
+        dst = np.concatenate([edges[:, 1], edges[:, 0]])
+        order = np.lexsort((dst, src))  # rows ascending, sorted within row
+        self.num_nodes = n
+        self.indices: np.ndarray = dst[order].astype(np.int32)
+        counts = np.bincount(src, minlength=n).astype(np.int64)
+        self.indptr: np.ndarray = np.concatenate(
+            [[0], np.cumsum(counts)]).astype(np.int32)
+        self.degrees: np.ndarray = counts.astype(np.int32)
+        self.indices_dev = jnp.asarray(self.indices)
+        self.indptr_dev = jnp.asarray(self.indptr)
+        self.degrees_dev = jnp.asarray(self.degrees)
+
+    # -- constructors (native edge-list generators; O(E) end to end) --------
+
+    @staticmethod
+    def random_regular(n: int, degree: int, seed: int = 42) -> "SparseTopology":
+        from . import native
+        return SparseTopology(n, native.random_regular_edges(n, degree, seed))
+
+    @staticmethod
+    def erdos_renyi(n: int, p: float, seed: int = 42) -> "SparseTopology":
+        from . import native
+        return SparseTopology(n, native.erdos_renyi_edges(n, p, seed))
+
+    @staticmethod
+    def barabasi_albert(n: int, m: int, seed: int = 42) -> "SparseTopology":
+        from . import native
+        return SparseTopology(n, native.barabasi_albert_edges(n, m, seed))
+
+    @staticmethod
+    def ring(n: int, k: int = 1) -> "SparseTopology":
+        idx = np.arange(n, dtype=np.int64)
+        edges = []
+        for d in range(1, k + 1):
+            if 2 * d < n:
+                edges.append(np.stack([idx, (idx + d) % n], axis=1))
+            elif 2 * d == n:  # antipodal link: one edge per pair
+                half = idx[: n // 2]
+                edges.append(np.stack([half, half + n // 2], axis=1))
+        return SparseTopology(n, np.concatenate(edges) if edges
+                              else np.empty((0, 2), np.int64))
+
+    @staticmethod
+    def from_dense(topology: "Topology") -> "SparseTopology":
+        i, j = np.nonzero(np.triu(topology.adjacency))
+        return SparseTopology(topology.num_nodes, np.stack([i, j], axis=1))
+
+    # -- queries (Topology-compatible) --------------------------------------
+
+    def get_peers(self, node_id: int) -> list[int]:
+        lo, hi = int(self.indptr[node_id]), int(self.indptr[node_id + 1])
+        return list(self.indices[lo:hi])
+
+    def size(self, node: Optional[int] = None) -> int:
+        if node is None:
+            return self.num_nodes
+        return int(self.degrees[node])
+
+    def sample_peers(self, key: jax.Array) -> jax.Array:
+        """One uniform neighbor per node, int32 [N]; -1 for isolated nodes."""
+        deg = self.degrees_dev
+        r = jax.random.randint(key, (self.num_nodes,), 0,
+                               jnp.maximum(deg, 1), dtype=jnp.int32)
+        peers = self.indices_dev[self.indptr_dev[:-1] + r]
+        return jnp.where(deg > 0, peers, -1).astype(jnp.int32)
+
+    @property
+    def adjacency(self):
+        raise AttributeError(
+            "SparseTopology does not materialize a dense adjacency; use "
+            "Topology for features that need one (mixing matrices, "
+            "All2AllGossipSimulator) or from_dense/to_dense for small N")
+
+    adjacency_dev = adjacency
+
+    def to_dense(self) -> "Topology":
+        """Materialize a dense :class:`Topology` (small N only)."""
+        a = np.zeros((self.num_nodes, self.num_nodes), dtype=bool)
+        for i in range(self.num_nodes):
+            a[i, self.indices[self.indptr[i]:self.indptr[i + 1]]] = True
+        return Topology(a)
 
 
 # ---------------------------------------------------------------------------

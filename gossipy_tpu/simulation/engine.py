@@ -76,17 +76,36 @@ def host_callbacks_supported() -> bool:
     Some PJRT backends (e.g. the tunneled single-chip runtime) do not
     implement host send/recv: unordered callbacks raise UNIMPLEMENTED and
     ordered ones HANG — so live event receivers must fall back to post-run
-    replay there rather than deadlock the run.
+    replay there rather than deadlock the run. Live emission uses
+    ``ordered=True``, so that exact mode is probed: first unordered (the
+    fast-failing signature), then ordered in a watchdog thread whose
+    timeout converts a hang into "unsupported".
     """
     global _HOST_CALLBACKS_SUPPORTED
     if _HOST_CALLBACKS_SUPPORTED is None:
-        def probe(x):
-            jax.experimental.io_callback(lambda _: None, None, x,
-                                         ordered=False)
-            return x
+        def probe(ordered):
+            def fn(x):
+                jax.experimental.io_callback(lambda _: None, None, x,
+                                             ordered=ordered)
+                return x
+            jax.block_until_ready(jax.jit(fn)(jnp.int32(0)))
+
         try:
-            jax.block_until_ready(jax.jit(probe)(jnp.int32(0)))
-            _HOST_CALLBACKS_SUPPORTED = True
+            probe(ordered=False)
+            import threading
+            done = threading.Event()
+
+            def ordered_probe():
+                try:
+                    probe(ordered=True)
+                    done.set()
+                except Exception:
+                    pass  # leaves done unset -> unsupported
+
+            t = threading.Thread(target=ordered_probe, daemon=True)
+            t.start()
+            t.join(timeout=30.0)
+            _HOST_CALLBACKS_SUPPORTED = done.is_set()
         except Exception:
             _HOST_CALLBACKS_SUPPORTED = False
     return _HOST_CALLBACKS_SUPPORTED
@@ -243,6 +262,7 @@ class GossipSimulator(SimulationEventSender):
             max_fires_per_round = 1 if sync else 2
         self.F = int(max_fires_per_round)
         assert self.F >= 1
+        self._warn_if_mailbox_undersized()
 
         self.data = {k: jnp.asarray(v) for k, v in data.items()}
         self.has_local_test = "xte" in data
@@ -267,6 +287,52 @@ class GossipSimulator(SimulationEventSender):
                 "fused_merge only fuses the MERGE_UPDATE path"
 
     # -- setup -------------------------------------------------------------
+
+    def _warn_if_mailbox_undersized(self) -> None:
+        """Warn when the K-slot mailbox will drop a material message fraction.
+
+        Overflowed messages are honestly counted as "failed", but a user on a
+        high-fan-in topology (clique at 100+ nodes, BA hubs) should hear
+        about it up front. Expected same-round fan-in of node i under
+        uniform peer sampling is ``lam_i = sum_{j in N(i)} F / deg_j``; the
+        slot-overflow probability is approximated by the Poisson tail
+        ``P(X > K)`` at ``max_i lam_i`` (delays spreading arrivals across
+        rounds make this an upper-ish estimate; replies add ~the same again
+        for PUSH_PULL).
+        """
+        if self.n_nodes == 0:
+            return
+        deg = np.maximum(np.asarray(self.topology.degrees, dtype=np.float64), 1.0)
+        inv = self.F / deg  # per-sender hit probability on each out-neighbor
+        try:
+            adj = self.topology.adjacency
+        except AttributeError:  # SparseTopology refuses dense materialization
+            adj = None
+        if adj is not None:
+            # Fan-in of i = sum over SENDERS j (adj[j, i]) of F/deg_j — a
+            # column sum (adjacency rows are out-neighbors; directed
+            # adjacencies are allowed).
+            lam_max = float((inv @ adj).max())
+        else:
+            # CSR rows are out-neighbor lists: scatter each sender row's
+            # F/deg into its targets.
+            lam = np.zeros(self.n_nodes)
+            degrees = np.asarray(self.topology.degrees)
+            if degrees.sum():
+                np.add.at(lam, self.topology.indices, np.repeat(inv, degrees))
+            lam_max = float(lam.max())
+        if lam_max <= 0.0:
+            return
+        # P(Poisson(lam) > K) = 1 - sum_{x<=K} e^-lam lam^x / x!
+        terms = np.cumprod([1.0] + [lam_max / x for x in range(1, self.K + 1)])
+        p_over = max(1.0 - float(np.exp(-lam_max) * terms.sum()), 0.0)
+        if p_over > 1e-3:
+            import warnings
+            warnings.warn(
+                f"mailbox_slots={self.K} may overflow on this topology: "
+                f"worst-case expected same-round fan-in {lam_max:.1f} gives "
+                f"~{p_over:.1%} per-node-round message loss (counted as "
+                f"'failed'). Raise mailbox_slots to silence.")
 
     def _local_data(self):
         return (self.data["xtr"], self.data["ytr"], self.data["mtr"])
@@ -792,6 +858,35 @@ class GossipSimulator(SimulationEventSender):
             template = shard_state(template, mesh)
         return restore_checkpoint(path, template, key)
 
+    def _make_run(self, n_rounds: int, live: bool):
+        """The ``n_rounds``-round scan as a pure (state, key) -> (state,
+        stats) function — the unit :meth:`start` jits and :meth:`lower_start`
+        AOT-lowers."""
+        def run(state, key):
+            last = state.round + n_rounds - 1
+
+            def body(st, _):
+                st, stats = self._round(st, key, last)
+                if live:
+                    self._emit_live(st, stats)
+                return st, stats
+            return jax.lax.scan(body, state, None, length=n_rounds)
+        return run
+
+    def lower_start(self, state: SimState, n_rounds: int = 100,
+                    key: Optional[jax.Array] = None):
+        """AOT-lower the ``n_rounds`` scan program for this state's shapes.
+
+        ``.compile()`` on the result exposes XLA's own ``cost_analysis()``
+        (FLOPs, bytes accessed) and ``as_text()`` (HLO) — the basis for the
+        MFU numbers in ``bench.py --mfu`` and docs/performance.md. The
+        reference has no analogue (its rounds are Python loops; SURVEY §5
+        tracing/profiling).
+        """
+        if key is None:
+            key = jax.random.PRNGKey(42)
+        return jax.jit(self._make_run(n_rounds, live=False)).lower(state, key)
+
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None,
               profile_dir: Optional[str] = None) -> tuple[SimState, SimulationReport]:
@@ -817,16 +912,7 @@ class GossipSimulator(SimulationEventSender):
         first_round = int(np.asarray(state.round))
         cache_k = ("start", n_rounds, self._cache_salt(), live)
         if cache_k not in self._jit_cache:
-            def run(state, key):
-                last = state.round + n_rounds - 1
-
-                def body(st, _):
-                    st, stats = self._round(st, key, last)
-                    if live:
-                        self._emit_live(st, stats)
-                    return st, stats
-                return jax.lax.scan(body, state, None, length=n_rounds)
-            self._jit_cache[cache_k] = jax.jit(run)
+            self._jit_cache[cache_k] = jax.jit(self._make_run(n_rounds, live))
 
         if profile_dir is not None:
             with jax.profiler.trace(profile_dir):

@@ -162,6 +162,12 @@ class JSONLinesReceiver(SimulationEventReceiver):
     "local": {metric: mean} | null, "global": {...} | null}``.
     Works replayed (default) or live (``live=True`` streams rows during the
     jitted run through the ordered io_callback).
+
+    One instance serves ONE simulator at a time: rows are assembled in a
+    mutable per-round buffer, so attaching the same instance to two
+    concurrently-running simulators interleaves fields across them. Use it
+    as a context manager (``with JSONLinesReceiver(p) as rx: ...``) or call
+    :meth:`close` when done.
     """
 
     def __init__(self, path: str, live: bool = False):
@@ -187,3 +193,10 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     def close(self):
         self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -175,7 +175,9 @@ def choice_not_n(mn: int, mx: int, notn: int, key: jax.Array) -> jax.Array:
     reference code."""
     if not mn <= notn <= mx:
         return jax.random.randint(key, (), mn, mx + 1)
-    assert mn < mx, f"no value in [{mn}, {mx}] left after excluding {notn}"
+    if mn >= mx:  # host-side check on static ints; survives python -O
+        raise ValueError(
+            f"no value in [{mn}, {mx}] left after excluding {notn}")
     v = jax.random.randint(key, (), mn, mx)  # [mn, mx-1]
     return jnp.where(v >= notn, v + 1, v)
 

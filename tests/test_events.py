@@ -141,6 +141,21 @@ def test_jsonlines_receiver_writes_rows(tmp_path, key):
     assert all(0.0 <= a <= 1.0 for a in accs)
 
 
+def test_jsonlines_receiver_context_manager(tmp_path, key):
+    import json
+
+    from gossipy_tpu.simulation import JSONLinesReceiver
+
+    sim = make_sim()
+    path = str(tmp_path / "metrics.jsonl")
+    with JSONLinesReceiver(path) as rec:
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key)
+    assert rec._fh.closed  # context exit closes the sink
+    assert len([json.loads(l) for l in open(path)]) == 2
+
+
 def test_live_falls_back_to_replay_without_host_callbacks(key, monkeypatch):
     """Backends without host send/recv (e.g. the tunneled TPU runtime) must
     not hang on live receivers: the engine falls back to post-run replay."""

@@ -115,3 +115,14 @@ def test_choice_not_n_excludes_and_covers():
     # Excluded value outside the range: plain uniform over [mn, mx].
     vals = {int(choice_not_n(0, 2, 9, jax.random.PRNGKey(i))) for i in range(60)}
     assert vals == {0, 1, 2}
+
+
+def test_choice_not_n_empty_range_raises():
+    """mn == mx == notn leaves nothing to draw: a real ValueError (not a
+    strippable assert) must stop the silent contract violation."""
+    import pytest
+
+    from gossipy_tpu.utils import choice_not_n
+
+    with pytest.raises(ValueError, match="no value"):
+        choice_not_n(3, 3, 3, jax.random.PRNGKey(0))

@@ -277,6 +277,33 @@ class TestMessageAccounting:
         # every message carrying a model.
         assert report.total_size < report.sent_messages * 10
 
+    def test_mailbox_warning_regimes(self, key):
+        """The undersized-mailbox warning fires exactly in the dangerous
+        regimes: hub fan-in (BA-style star of low-degree senders) and
+        lowered slot counts — and stays quiet for regular topologies at the
+        default capacity (expected fan-in ~1, Poisson tail ~1e-4)."""
+        import warnings as w
+
+        from gossipy_tpu.core import SparseTopology
+
+        with w.catch_warnings():
+            w.simplefilter("error")  # quiet case: any warning -> failure
+            make_sim(n_nodes=16, topo=Topology.ring(16, k=3))
+        # Same hub shape through the CSR (SparseTopology) lambda path.
+        edges = np.array([[i, 0] for i in range(1, 12)])
+        with pytest.warns(UserWarning, match="may overflow"):
+            make_sim(n_nodes=12, topo=SparseTopology(12, edges),
+                     mailbox_slots=2)
+        # DIRECTED star: fan-in is a column sum (who targets me), not a row
+        # sum (whom I target). 40 spokes all aiming at node 0 must warn at
+        # the default 6 slots even though every row degree is 1.
+        n = 41
+        adj = np.zeros((n, n), dtype=bool)
+        adj[1:, 0] = True
+        adj[0, 1] = True
+        with pytest.warns(UserWarning, match="fan-in 40"):
+            make_sim(n_nodes=n, topo=Topology(adj))
+
     def test_no_faults_no_failures(self, key):
         """drop=0, online=1, zero delay, mailbox >= fan-in: every message
         delivers (mailbox_slots sized to n-1 so overflow is impossible)."""
@@ -293,7 +320,8 @@ class TestMessageAccounting:
         adj = np.zeros((n, n), dtype=bool)
         adj[1:, 0] = True  # spokes only know the hub
         adj[0, 1] = True   # hub sends to node 1 (keeps every row nonempty)
-        sim = make_sim(n_nodes=n, topo=Topology(adj), mailbox_slots=1)
+        with pytest.warns(UserWarning, match="mailbox_slots=1 may overflow"):
+            sim = make_sim(n_nodes=n, topo=Topology(adj), mailbox_slots=1)
         st = sim.init_nodes(key)
         rounds = 5
         st, report = sim.start(st, n_rounds=rounds, key=key)
