@@ -38,8 +38,9 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Me
     """A 1-D device mesh over the first ``n_devices`` devices."""
     devs = jax.devices()
     if n_devices is not None:
-        assert n_devices <= len(devs), \
-            f"requested {n_devices} devices, have {len(devs)}"
+        if n_devices > len(devs):  # explicit: must survive python -O
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis_name,))
 
@@ -55,8 +56,9 @@ def make_mesh_2d(n_hosts: int, devices_per_host: Optional[int] = None,
     """
     devs = jax.devices()
     per = devices_per_host or len(devs) // n_hosts
-    assert n_hosts * per <= len(devs), \
-        f"requested {n_hosts}x{per} devices, have {len(devs)}"
+    if n_hosts * per > len(devs):
+        raise ValueError(
+            f"requested {n_hosts}x{per} devices, have {len(devs)}")
     try:
         # On real multi-host hardware, plain jax.devices() order is NOT
         # guaranteed host-contiguous; the hybrid mesh helper places the DCN
@@ -76,6 +78,40 @@ def make_mesh_2d(n_hosts: int, devices_per_host: Optional[int] = None,
     return Mesh(arr, axis_names)
 
 
+def _tp_device_grid(devices, n_node_devices: int,
+                    n_model_devices: int) -> np.ndarray:
+    """Host-contiguous ``(nodes, model)`` device grid.
+
+    Plain ``jax.devices()`` order is not guaranteed host-contiguous across
+    processes; a naive reshape could pair a model-axis group across DCN,
+    putting every tensor-parallel contraction psum on the slow links. This
+    groups devices by ``process_index`` so each model-axis row lies within
+    one host (psums ride ICI) and the nodes axis spans hosts (DCN only
+    carries node-axis traffic, which the engine already keeps coarse).
+    Pure placement logic, unit-testable with fake device objects.
+    """
+    by_host: dict[int, list] = {}
+    for d in devices:
+        by_host.setdefault(d.process_index, []).append(d)
+    hosts = [sorted(v, key=lambda d: d.id) for _, v in sorted(by_host.items())]
+    sizes = {len(h) for h in hosts}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"uneven device count per host: {sorted(len(h) for h in hosts)}")
+    per_host = sizes.pop()
+    if per_host % n_model_devices != 0:
+        raise ValueError(
+            f"model axis ({n_model_devices}) must divide the per-host device "
+            f"count ({per_host}) so tensor-parallel groups stay on ICI")
+    rows = [h[i:i + n_model_devices]
+            for h in hosts for i in range(0, per_host, n_model_devices)]
+    if len(rows) != n_node_devices:
+        raise ValueError(
+            f"device layout yields {len(rows)} node rows, "
+            f"requested {n_node_devices}")
+    return np.array(rows)
+
+
 def make_mesh_tp(n_node_devices: int, n_model_devices: int,
                  axis_names: tuple[str, str] = (NODE_AXIS, MODEL_AXIS)) -> Mesh:
     """A 2-D ``(nodes, model)`` mesh: data parallelism over the node
@@ -83,23 +119,21 @@ def make_mesh_tp(n_node_devices: int, n_model_devices: int,
 
     With this mesh, :func:`state_shardings` places the node dimension on the
     ``nodes`` axis only and additionally shards each parameter leaf's largest
-    eligible non-node dimension over the ``model`` axis.
+    eligible non-node dimension over the ``model`` axis. Multi-host layouts
+    are placed host-contiguously (see :func:`_tp_device_grid`): the model
+    axis stays innermost on ICI, hosts span the nodes axis.
     """
     devs = jax.devices()
     need = n_node_devices * n_model_devices
-    assert need <= len(devs), f"requested {need} devices, have {len(devs)}"
-    if jax.process_count() > 1:
-        # Plain device order is not host-contiguous across processes; a
-        # naive reshape could pair a model-axis group across DCN, putting
-        # every contraction psum on the slow links. Build the mesh
-        # explicitly (mesh_utils.create_hybrid_device_mesh with the model
-        # axis innermost) rather than silently degrading.
-        raise NotImplementedError(
-            "make_mesh_tp assumes single-process device order; on a "
-            "multi-host run build the Mesh from "
-            "mesh_utils.create_hybrid_device_mesh (model axis innermost) "
-            "and pass axis_names=('nodes', 'model')")
-    return Mesh(np.array(devs[:need]).reshape(n_node_devices, n_model_devices),
+    if need > len(devs):  # explicit: must survive python -O
+        raise ValueError(f"requested {need} devices, have {len(devs)}")
+    if jax.process_count() > 1 and need != len(devs):
+        # A device subset cannot be chosen consistently across processes
+        # without leaving some process idle; require the full complement.
+        raise ValueError(
+            f"multi-host TP mesh must use every attached device: "
+            f"requested {need} of {len(devs)}")
+    return Mesh(_tp_device_grid(devs[:need], n_node_devices, n_model_devices),
                 axis_names)
 
 

@@ -31,6 +31,67 @@ def build(n_nodes=16, data=None):
     return sim, disp
 
 
+class FakeDev:
+    """Stand-in device for placement-logic tests (id + process_index)."""
+
+    def __init__(self, id, process_index):
+        self.id = id
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"d{self.id}@h{self.process_index}"
+
+    def __eq__(self, other):
+        return (self.id, self.process_index) == (other.id, other.process_index)
+
+    def __hash__(self):
+        return hash((self.id, self.process_index))
+
+
+class TestTpDeviceGrid:
+    """Host-contiguous TP placement (multi-host make_mesh_tp)."""
+
+    def test_model_groups_stay_intra_host(self):
+        from gossipy_tpu.parallel import _tp_device_grid
+        devs = [FakeDev(i, i // 4) for i in range(16)]  # 4 hosts x 4 chips
+        grid = _tp_device_grid(devs, 8, 2)
+        assert grid.shape == (8, 2)
+        # Every model-axis row within one host (TP psums ride ICI) ...
+        for row in grid:
+            assert len({d.process_index for d in row}) == 1
+        # ... and the node axis spans all hosts.
+        assert {d.process_index for d in grid[:, 0]} == {0, 1, 2, 3}
+        # All 16 devices used exactly once.
+        assert len({d.id for d in grid.ravel()}) == 16
+
+    def test_interleaved_device_order_is_regrouped(self):
+        """jax.devices() order is not host-contiguous on real pods; the
+        grid must regroup by process_index, not trust list order."""
+        from gossipy_tpu.parallel import _tp_device_grid
+        devs = [FakeDev(i, i % 4) for i in range(16)]  # round-robin hosts
+        grid = _tp_device_grid(devs, 8, 2)
+        for row in grid:
+            assert len({d.process_index for d in row}) == 1
+
+    def test_model_axis_exceeding_host_raises(self):
+        from gossipy_tpu.parallel import _tp_device_grid
+        devs = [FakeDev(i, i // 4) for i in range(16)]
+        with pytest.raises(ValueError, match="divide the per-host"):
+            _tp_device_grid(devs, 2, 8)  # 8-way TP > 4 chips/host
+
+    def test_uneven_hosts_raise(self):
+        from gossipy_tpu.parallel import _tp_device_grid
+        devs = [FakeDev(i, 0 if i < 5 else 1) for i in range(8)]
+        with pytest.raises(ValueError, match="uneven"):
+            _tp_device_grid(devs, 4, 2)
+
+    def test_single_host_matches_plain_reshape(self):
+        from gossipy_tpu.parallel import _tp_device_grid
+        devs = [FakeDev(i, 0) for i in range(8)]
+        grid = _tp_device_grid(devs, 4, 2)
+        assert [d.id for d in grid.ravel()] == list(range(8))
+
+
 def test_mesh_has_8_devices():
     mesh = make_mesh()
     assert mesh.devices.size == 8
