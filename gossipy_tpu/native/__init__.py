@@ -70,6 +70,18 @@ def load() -> Optional[ctypes.CDLL]:
         lib.gen_barabasi_albert.restype = None
         lib.gen_ring.argtypes = [ctypes.c_int32, ctypes.c_int32, u8p]
         lib.gen_ring.restype = None
+        i32p = np.ctypeslib.ndpointer(dtype=np.int32, ndim=2,
+                                      flags="C_CONTIGUOUS")
+        lib.gen_random_regular_edges.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64, i32p]
+        lib.gen_random_regular_edges.restype = ctypes.c_int64
+        lib.gen_erdos_renyi_edges.argtypes = [
+            ctypes.c_int32, ctypes.c_double, ctypes.c_uint64, i32p,
+            ctypes.c_int64]
+        lib.gen_erdos_renyi_edges.restype = ctypes.c_int64
+        lib.gen_barabasi_albert_edges.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64, i32p]
+        lib.gen_barabasi_albert_edges.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -106,6 +118,45 @@ def barabasi_albert(n: int, m: int, seed: int = 42) -> np.ndarray:
     adj = np.zeros((n, n), dtype=np.uint8)
     lib.gen_barabasi_albert(n, m, seed, adj)
     return adj.view(bool)  # same itemsize; zero-copy
+
+
+def random_regular_edges(n: int, k: int, seed: int = 42) -> np.ndarray:
+    """Undirected edge list [E, 2] of a k-regular graph — the O(E) path for
+    node counts where the dense [n, n] buffer would not fit."""
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    edges = np.empty((n * k // 2 + 1, 2), dtype=np.int32)
+    m = lib.gen_random_regular_edges(n, k, seed, edges)
+    if m == -1:
+        raise ValueError(f"no {k}-regular graph on {n} nodes (n*k must be "
+                         f"even and k < n)")
+    if m < 0:
+        raise RuntimeError("pairing model failed to find a simple graph")
+    return edges[:m]
+
+
+def erdos_renyi_edges(n: int, p: float, seed: int = 42) -> np.ndarray:
+    """Undirected edge list [E, 2] of G(n, p) via skip-sampling (O(E + n))."""
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    mean = p * n * (n - 1) / 2
+    cap = int(mean + 6 * np.sqrt(mean + 1) + 64)
+    while True:
+        edges = np.empty((cap, 2), dtype=np.int32)
+        m = lib.gen_erdos_renyi_edges(n, float(p), seed, edges, cap)
+        if m <= cap:
+            return edges[:m]
+        cap = int(m) + 64  # same seed -> same sequence; retry exact-sized
+
+
+def barabasi_albert_edges(n: int, m: int, seed: int = 42) -> np.ndarray:
+    """Undirected edge list [E, 2] of a Barabasi-Albert graph."""
+    lib = load()
+    assert lib is not None, "native graphgen unavailable"
+    assert 1 <= m < n, "need 1 <= m < n"
+    edges = np.empty((m * (n - m - 1) + m + 1, 2), dtype=np.int32)
+    cnt = lib.gen_barabasi_albert_edges(n, m, seed, edges)
+    return edges[:cnt]
 
 
 def ring(n: int, k: int = 1) -> np.ndarray:

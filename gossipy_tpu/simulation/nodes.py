@@ -122,7 +122,7 @@ class CacheNeighGossipSimulator(GossipSimulator):
         slot_of = np.full((n, n), -1, dtype=np.int32)
         max_deg = int(self.topology.degrees.max()) if n else 0
         for i in range(n):
-            for s, j in enumerate(np.where(self.topology.adjacency[i])[0]):
+            for s, j in enumerate(self.topology.get_peers(i)):
                 slot_of[i, j] = s
         self.max_deg = max(max_deg, 1)
         self.slot_of = jnp.asarray(slot_of)
