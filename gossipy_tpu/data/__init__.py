@@ -19,6 +19,7 @@ in the returned metadata and by a warning).
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Callable, Optional
 
@@ -49,7 +50,25 @@ UCI_SHAPES = {
     "ionosphere": (351, 34, 2),
     "abalone": (4177, 8, 3),
     "banknote": (1372, 4, 2),
-    "reuters": (8000, 100, 2),
+    # Joachims' svmlight example corpus (what the reference calls "reuters"):
+    # 2000 train + 600 test rows, train side 9947 features.
+    "reuters": (2600, 9947, 2),
+}
+
+# (url, label_column) per downloadable UCI name — mirrors the reference's
+# UCI_URL_AND_CLASS (data/__init__.py:45-52), including its abalone quirk:
+# column 0 (sex M/F/I) is the LABEL, the 8 measurements are features.
+UCI_URLS = {
+    "spambase": ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+                 "spambase/spambase.data", 57),
+    "sonar": ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+              "undocumented/connectionist-bench/sonar/sonar.all-data", 60),
+    "ionosphere": ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+                   "ionosphere/ionosphere.data", 34),
+    "abalone": ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+                "abalone/abalone.data", 0),
+    "banknote": ("https://archive.ics.uci.edu/ml/machine-learning-databases/"
+                 "00267/data_banknote_authentication.txt", 4),
 }
 
 
@@ -388,25 +407,64 @@ def load_classification_dataset(name: str = "spambase", normalize: bool = True,
     return X, y
 
 
+def _fetch_to(url: str, path: str, timeout: float = 30.0) -> None:
+    """Download ``url`` to ``path`` with a socket timeout (urlretrieve has
+    none — a half-open connection would hang the loader forever)."""
+    import shutil
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r, \
+            open(path, "wb") as f:
+        shutil.copyfileobj(r, f)
+
+
+def _label_encode(values) -> np.ndarray:
+    """Sorted-unique label encoding (sklearn LabelEncoder semantics)."""
+    classes = {v: i for i, v in enumerate(sorted(set(values)))}
+    return np.array([classes[v] for v in values], dtype=np.int64)
+
+
+def _load_reuters():
+    """Joachims' svmlight example corpus (reference data/__init__.py:598-607):
+    train.dat + test.dat stacked, the narrower side zero-padded to the wider
+    feature count, labels {-1, +1} label-encoded to {0, 1}."""
+    import tarfile
+    import tempfile
+    import urllib.request
+
+    from sklearn.datasets import load_svmlight_file
+
+    url = "http://download.joachims.org/svm_light/examples/example1.tar.gz"
+    with tempfile.TemporaryDirectory() as tmp:
+        arc = os.path.join(tmp, "example1.tar.gz")
+        _fetch_to(url, arc)
+        with tarfile.open(arc) as tf:
+            tf.extractall(tmp, filter="data")  # refuse path traversal
+        folder = os.path.join(tmp, "example1")
+        X_tr, y_tr = load_svmlight_file(os.path.join(folder, "train.dat"))
+        X_te, y_te = load_svmlight_file(os.path.join(folder, "test.dat"))
+    X_tr, X_te = X_tr.toarray(), X_te.toarray()
+    d = max(X_tr.shape[1], X_te.shape[1])
+    X_tr = np.pad(X_tr, [(0, 0), (0, d - X_tr.shape[1])])
+    X_te = np.pad(X_te, [(0, 0), (0, d - X_te.shape[1])])
+    X = np.vstack([X_tr, X_te])
+    y = _label_encode(np.concatenate([y_tr, y_te]).tolist())
+    return X, y
+
+
 def _load_uci_or_synthetic(name: str, allow_synthetic: bool):
     n, d, c = UCI_SHAPES[name]
     try:  # pragma: no cover - no egress in CI
-        import io
         import urllib.request
-        urls = {
-            "spambase": "https://archive.ics.uci.edu/ml/machine-learning-databases/spambase/spambase.data",
-            "sonar": "https://archive.ics.uci.edu/ml/machine-learning-databases/undocumented/connectionist-bench/sonar/sonar.all-data",
-            "ionosphere": "https://archive.ics.uci.edu/ml/machine-learning-databases/ionosphere/ionosphere.data",
-            "banknote": "https://archive.ics.uci.edu/ml/machine-learning-databases/00267/data_banknote_authentication.txt",
-        }
-        if name not in urls:
-            raise OSError("no URL")
-        raw = urllib.request.urlopen(urls[name], timeout=10).read().decode()
+
+        if name == "reuters":
+            return _load_reuters()
+        url, label_col = UCI_URLS[name]
+        raw = urllib.request.urlopen(url, timeout=10).read().decode()
         rows = [r.split(",") for r in raw.strip().split("\n")]
-        X = np.array([[float(v) for v in r[:-1]] for r in rows], dtype=np.float32)
-        last = [r[-1].strip() for r in rows]
-        classes = {v: i for i, v in enumerate(sorted(set(last)))}
-        y = np.array([classes[v] for v in last], dtype=np.int64)
+        y = _label_encode([r[label_col].strip() for r in rows])
+        X = np.array([[float(v) for i, v in enumerate(r) if i != label_col]
+                      for r in rows], dtype=np.float32)
         return X, y
     except Exception:
         if not allow_synthetic:
@@ -417,19 +475,61 @@ def _load_uci_or_synthetic(name: str, allow_synthetic: bool):
         return _synthetic_classification(name, n, d, c)
 
 
+def _load_movielens(name: str):
+    """Download + parse a MovieLens archive (reference data/__init__.py:628-681):
+    ratings keyed by dense re-mapped user id, items dense re-mapped in first-
+    appearance order."""
+    import tempfile
+    import urllib.request
+    import zipfile
+
+    files = {"ml-100k": ("u.data", "\t"), "ml-1m": ("ratings.dat", "::"),
+             "ml-10m": ("ratings.dat", "::"), "ml-20m": ("ratings.csv", ",")}
+    filename, sep = files[name]
+    url = f"https://files.grouplens.org/datasets/movielens/{name}.zip"
+    ratings: dict[int, list[tuple[int, float]]] = {}
+    umap: dict[int, int] = {}
+    imap: dict[int, int] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        arc = os.path.join(tmp, f"{name}.zip")
+        _fetch_to(url, arc)
+        with zipfile.ZipFile(arc) as zf:
+            member = next(m for m in zf.namelist()
+                          if m.endswith("/" + filename) or m == filename)
+            with zf.open(member) as f:
+                for line in f.read().decode().strip().split("\n"):
+                    if name == "ml-20m" and line.startswith("userId"):
+                        continue  # csv header
+                    u, i, r = line.strip().split(sep)[:3]
+                    u, i, r = int(u), int(i), float(r)
+                    if u not in umap:
+                        umap[u] = len(umap)
+                        ratings[umap[u]] = []
+                    if i not in imap:
+                        imap[i] = len(imap)
+                    ratings[umap[u]].append((imap[i], r))
+    return ratings, len(umap), len(imap)
+
+
 def load_recsys_dataset(name: str = "ml-100k", allow_synthetic: bool = True):
     """MovieLens ratings as {user: [(item, rating)]}, n_users, n_items.
 
-    The reference downloads MovieLens archives (data/__init__.py:628-681);
-    without egress a synthetic low-rank rating matrix with matching sparsity
-    is generated.
+    Mirrors reference data/__init__.py:628-681 (zip download + dense id
+    remapping); when the download is unavailable (no egress) and
+    ``allow_synthetic``, a synthetic low-rank rating matrix with matching
+    sparsity is generated instead.
     """
-    sizes = {"ml-100k": (943, 1682, 100_000), "ml-1m": (6040, 3706, 1_000_000)}
+    sizes = {"ml-100k": (943, 1682, 100_000), "ml-1m": (6040, 3706, 1_000_000),
+             "ml-10m": (69_878, 10_677, 10_000_054),
+             "ml-20m": (138_493, 26_744, 20_000_263)}
     if name not in sizes:
         raise ValueError(f"Unknown recsys dataset: {name}")
     n_users, n_items, n_ratings = sizes[name]
-    if not allow_synthetic:
-        raise OSError("MovieLens download unavailable in this environment")
+    try:  # pragma: no cover - no egress in CI
+        return _load_movielens(name)
+    except Exception:
+        if not allow_synthetic:
+            raise
     warnings.warn(f"RecSys dataset '{name}' substituted with a synthetic "
                   "low-rank rating matrix (no egress).")
     rng = _name_seeded_rng(name)
@@ -460,31 +560,134 @@ def _synthetic_images(name: str, n: int, shape: tuple, c: int):
     return X, y
 
 
+def _download_cifar10():
+    """CIFAR-10 from the canonical plain-URL tar.gz (python pickle batches) —
+    no torchvision needed. Returns NHWC float32 in [0, 1]."""
+    import pickle
+    import tarfile
+    import tempfile
+    import urllib.request
+
+    url = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+    with tempfile.TemporaryDirectory() as tmp:
+        arc = os.path.join(tmp, "cifar10.tar.gz")
+        _fetch_to(url, arc)
+
+        def batch(tf, member):
+            d = pickle.load(tf.extractfile(member), encoding="bytes")
+            X = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return X.astype(np.float32) / 255.0, np.array(d[b"labels"],
+                                                          dtype=np.int64)
+        with tarfile.open(arc) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            tr = [batch(tf, members[f"cifar-10-batches-py/data_batch_{i}"])
+                  for i in range(1, 6)]
+            Xte, yte = batch(tf, members["cifar-10-batches-py/test_batch"])
+    Xtr = np.concatenate([x for x, _ in tr])
+    ytr = np.concatenate([y for _, y in tr])
+    return (Xtr, ytr), (Xte, yte)
+
+
 def get_CIFAR10(allow_synthetic: bool = True):
-    """CIFAR-10 train/test as NHWC float32 in [-1, 1]-ish range.
+    """CIFAR-10 train/test as NHWC float32.
 
     The reference uses torchvision downloads (data/__init__.py:684-726);
-    torchvision is absent here and there is no egress, so a deterministic
-    synthetic 32x32x3 10-class set of the same shape is substituted.
+    here the canonical plain-URL archive is parsed directly (no torchvision
+    dependency). Without egress and with ``allow_synthetic``, a
+    deterministic synthetic 32x32x3 10-class set of the same shape is
+    substituted.
     """
-    if not allow_synthetic:
-        raise OSError("CIFAR-10 download unavailable in this environment "
-                      "(torchvision missing / no egress)")
+    try:  # pragma: no cover - no egress in CI
+        return _download_cifar10()
+    except Exception:
+        if not allow_synthetic:
+            raise
     warnings.warn("CIFAR-10 substituted with synthetic 32x32x3 data (no egress).")
     Xtr, ytr = _synthetic_images("cifar10-train", 50_000, (32, 32, 3), 10)
     Xte, yte = _synthetic_images("cifar10-test", 10_000, (32, 32, 3), 10)
     return (Xtr, ytr), (Xte, yte)
 
 
+def _download_fashion_mnist():
+    """FashionMNIST from the canonical idx-format files (no torchvision).
+    Returns NHWC float32 in [0, 1]."""
+    import gzip
+    import urllib.request
+
+    base = "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
+
+    def fetch(fname):
+        return gzip.decompress(
+            urllib.request.urlopen(base + fname, timeout=30).read())
+
+    def images(buf):
+        n = int.from_bytes(buf[4:8], "big")
+        X = np.frombuffer(buf, dtype=np.uint8, offset=16).reshape(n, 28, 28, 1)
+        return X.astype(np.float32) / 255.0
+
+    def labels(buf):
+        return np.frombuffer(buf, dtype=np.uint8, offset=8).astype(np.int64)
+
+    Xtr = images(fetch("train-images-idx3-ubyte.gz"))
+    ytr = labels(fetch("train-labels-idx1-ubyte.gz"))
+    Xte = images(fetch("t10k-images-idx3-ubyte.gz"))
+    yte = labels(fetch("t10k-labels-idx1-ubyte.gz"))
+    return (Xtr, ytr), (Xte, yte)
+
+
 def get_FashionMNIST(allow_synthetic: bool = True):
     """FashionMNIST equivalent of :func:`get_CIFAR10` (reference :729-762)."""
-    if not allow_synthetic:
-        raise OSError("FashionMNIST download unavailable in this environment "
-                      "(torchvision missing / no egress)")
+    try:  # pragma: no cover - no egress in CI
+        return _download_fashion_mnist()
+    except Exception:
+        if not allow_synthetic:
+            raise
     warnings.warn("FashionMNIST substituted with synthetic 28x28x1 data (no egress).")
     Xtr, ytr = _synthetic_images("fmnist-train", 60_000, (28, 28, 1), 10)
     Xte, yte = _synthetic_images("fmnist-test", 10_000, (28, 28, 1), 10)
     return (Xtr, ytr), (Xte, yte)
+
+
+def _download_femnist(n_writers: int):
+    """FEMNIST from the tao-shen torch archive the reference uses
+    (data/__init__.py:765-778), with the cursor fix applied: writer ``i``
+    gets rows ``[cursor_i, cursor_i + n_i)``, cursors advancing."""
+    import tarfile
+    import tempfile
+
+    import torch
+
+    url = ("https://raw.githubusercontent.com/tao-shen/FEMNIST_pytorch/"
+           "master/femnist.tar.gz")
+
+    def to_numpy(X, y, ids, limit):
+        X = np.asarray(X, dtype=np.float32)
+        if X.max() > 1.5:  # stored as uint8 grays
+            X = X / 255.0
+        if X.ndim == 3:
+            X = X[..., None]  # NHWC single channel
+        y = np.asarray(y, dtype=np.int64)
+        assignment, cursor = [], 0
+        for ni in list(ids)[:limit]:
+            ni = int(ni)
+            assignment.append(np.arange(cursor, cursor + ni))
+            cursor += ni
+        return X[:cursor], y[:cursor], assignment
+
+    with tempfile.TemporaryDirectory() as tmp:
+        arc = os.path.join(tmp, "femnist.tar.gz")
+        _fetch_to(url, arc)
+        with tarfile.open(arc) as tf:
+            tf.extractall(tmp, filter="data")  # refuse path traversal
+        paths = [os.path.join(root, f)
+                 for root, _, files in os.walk(tmp) for f in files
+                 if f.endswith((".pt", ".pth"))]
+        tr_path = next(p for p in paths if "train" in os.path.basename(p))
+        te_path = next(p for p in paths if "test" in os.path.basename(p))
+        Xtr, ytr, ids_tr = torch.load(tr_path, map_location="cpu")
+        Xte, yte, ids_te = torch.load(te_path, map_location="cpu")
+    return (to_numpy(Xtr, ytr, ids_tr, n_writers),
+            to_numpy(Xte, yte, ids_te, n_writers))
 
 
 def get_FEMNIST(n_writers: int = 100, allow_synthetic: bool = True):
@@ -497,13 +700,15 @@ def get_FEMNIST(n_writers: int = 100, allow_synthetic: bool = True):
     assigned the FIRST writer's rows (the ``sum_tr = sum_te = 0`` bug); here
     the cursors advance — an intentional, documented fix.
 
-    No egress: a deterministic synthetic per-writer dataset is substituted
-    (62 classes as in EMNIST-byclass; writer shard sizes vary log-normally
-    like real handwriting corpora).
+    Without egress and with ``allow_synthetic``, a deterministic synthetic
+    per-writer dataset is substituted (62 classes as in EMNIST-byclass;
+    writer shard sizes vary log-normally like real handwriting corpora).
     """
-    if not allow_synthetic:
-        raise OSError("FEMNIST download unavailable in this environment "
-                      "(no egress)")
+    try:  # pragma: no cover - no egress in CI
+        return _download_femnist(n_writers)
+    except Exception:
+        if not allow_synthetic:
+            raise
     warnings.warn("FEMNIST substituted with synthetic per-writer 28x28 data "
                   "(no egress).")
     rng = _name_seeded_rng("femnist")
