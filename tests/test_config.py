@@ -1,0 +1,105 @@
+"""Experiment config system: dataclass <-> JSON <-> live simulator."""
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+from gossipy_tpu.config import ExperimentConfig, build_experiment, run_experiment
+
+
+def tiny_data(n=240, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    return X, y
+
+
+def tiny_cfg(**kw):
+    base = dict(n_nodes=8, topology="ring", topology_params={"k": 2},
+                delta=10, batch_size=8, learning_rate=0.5, n_rounds=8)
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+class TestSerialization:
+    def test_json_roundtrip(self, tmp_path):
+        cfg = tiny_cfg(model="mlp", model_params={"hidden_dims": [16]})
+        p = tmp_path / "exp.json"
+        cfg.to_json(str(p))
+        cfg2 = ExperimentConfig.from_json(str(p))
+        assert cfg2 == cfg
+
+    def test_from_json_string(self):
+        cfg = ExperimentConfig.from_json('{"n_nodes": 4, "model": "logreg"}')
+        assert cfg.n_nodes == 4
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown config fields"):
+            ExperimentConfig.from_dict({"n_nodez": 4})
+
+    def test_json_is_complete(self):
+        d = json.loads(tiny_cfg().to_json())
+        assert d["protocol"] == "PUSH" and d["delta"] == 10
+
+
+class TestBuild:
+    def test_build_gossip(self):
+        sim, disp = build_experiment(tiny_cfg(), data=tiny_data())
+        assert sim.n_nodes == 8 and sim.delta == 10
+
+    def test_build_tokenized_with_account(self):
+        cfg = tiny_cfg(simulator="tokenized", token_account="simple",
+                       token_account_params={"C": 3})
+        sim, _ = build_experiment(cfg, data=tiny_data())
+        assert sim.account.C == 3
+
+    def test_build_all2all(self):
+        cfg = tiny_cfg(simulator="all2all", handler="weighted",
+                       topology="clique", topology_params={})
+        sim, _ = build_experiment(cfg, data=tiny_data())
+        assert sim.mixing.shape == (8, 8)
+
+    def test_build_sparse_topology(self):
+        cfg = tiny_cfg(sparse_topology=True, topology="random_regular",
+                       topology_params={"degree": 4})
+        sim, _ = build_experiment(cfg, data=tiny_data())
+        from gossipy_tpu.core import SparseTopology
+        assert isinstance(sim.topology, SparseTopology)
+
+    def test_clear_errors(self):
+        with pytest.raises(ValueError, match="unknown topology"):
+            build_experiment(tiny_cfg(topology="hypercube"), data=tiny_data())
+        with pytest.raises(ValueError, match="unknown model"):
+            build_experiment(tiny_cfg(model="resnet50"), data=tiny_data())
+        with pytest.raises(ValueError, match="unknown simulator"):
+            build_experiment(tiny_cfg(simulator="quantum"), data=tiny_data())
+        with pytest.raises(ValueError, match="unknown handler"):
+            build_experiment(tiny_cfg(handler="adam?"), data=tiny_data())
+
+
+class TestRun:
+    def test_run_learns(self):
+        state, report = run_experiment(tiny_cfg(), data=tiny_data())
+        assert report.curves(local=False)["accuracy"][-1] > 0.8
+
+    def test_run_from_json_reproducible(self, tmp_path):
+        cfg = tiny_cfg()
+        p = tmp_path / "exp.json"
+        cfg.to_json(str(p))
+        _, r1 = run_experiment(ExperimentConfig.from_json(str(p)),
+                               data=tiny_data())
+        _, r2 = run_experiment(ExperimentConfig.from_json(str(p)),
+                               data=tiny_data())
+        a1 = r1.curves(local=False)["accuracy"]
+        a2 = r2.curves(local=False)["accuracy"]
+        assert np.allclose(a1, a2)
+
+    def test_run_with_dataset_name(self):
+        cfg = tiny_cfg(dataset="breast", n_nodes=8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, report = run_experiment(cfg)
+        assert np.isfinite(report.curves(local=False)["accuracy"][-1])
