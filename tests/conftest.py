@@ -43,6 +43,11 @@ if not _DO_REEXEC:
 
 def pytest_configure(config):
     if not _DO_REEXEC:
+        # Persistent XLA compilation cache: the suite's wall-clock is
+        # dominated by per-config scan compiles; identical HLO across runs
+        # (and across same-shaped tests) loads from disk instead.
+        from gossipy_tpu import enable_compilation_cache
+        enable_compilation_cache()
         return
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
