@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import sys
 
+import _common  # noqa: F401  (inserts the repo root for source checkouts)
+
 from gossipy_tpu.config import ExperimentConfig, run_experiment
 
 

@@ -23,17 +23,15 @@ CASES = [
 ]
 
 
-def run_example(script, args):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    # Drop TPU-plugin sitecustomize entries, same as conftest's re-exec.
-    env["PYTHONPATH"] = os.pathsep.join(
-        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-         if p and "axon" not in p])
+def run_example(script, args, expect_json=True):
+    from _virtual_mesh import virtual_mesh_env  # conftest puts REPO on sys.path
+    env = virtual_mesh_env(1)  # CPU backend, TPU plugin stripped
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", script)] + args,
         capture_output=True, text=True, timeout=500, env=env, cwd=REPO)
     assert out.returncode == 0, out.stderr[-2000:]
+    if not expect_json:
+        return out.stdout
     last = [l for l in out.stdout.strip().splitlines() if l.startswith("{")][-1]
     return json.loads(last)
 
@@ -44,6 +42,18 @@ def test_example_smoke(script, args):
     assert summary["rounds"] >= 1
     assert "final" in summary
     assert all(np.isfinite(v) for v in summary["final"].values()), summary
+
+
+def test_config_runner_smoke(tmp_path):
+    """main_from_config runs an experiment from a JSON file end to end."""
+    from gossipy_tpu.config import ExperimentConfig
+    p = tmp_path / "tiny.json"
+    ExperimentConfig(dataset="breast_cancer", n_nodes=8, delta=10,
+                     topology="ring", topology_params={"k": 2},
+                     batch_size=16, learning_rate=0.3,
+                     n_rounds=3).to_json(str(p))
+    out = run_example("main_from_config.py", [str(p)], expect_json=False)
+    assert "final global accuracy" in out
 
 
 def test_example_repetitions_smoke():
