@@ -41,8 +41,9 @@ BASELINE_ROUNDS = 10
 DEGREE = 20
 # Reference rounds/s measured on this container's CPU (fallback when the
 # live baseline run fails for environmental reasons). Measured 2026-07-29:
-# 3 rounds in 2.62s = 1.14 r/s.
+# FALLBACK_BASELINE_ROUNDS rounds in 2.62s = 1.14 r/s.
 FALLBACK_BASELINE = 1.14
+FALLBACK_BASELINE_ROUNDS = 3
 
 
 def make_data():
@@ -425,9 +426,8 @@ def main():
               f"using fallback {FALLBACK_BASELINE} r/s", file=sys.stderr)
         baseline = FALLBACK_BASELINE
         baseline_source = "fallback"
-    # The canned fallback figure was a 3-round measurement (see
-    # FALLBACK_BASELINE); only live runs use BASELINE_ROUNDS.
-    ref_rounds = BASELINE_ROUNDS if baseline_source == "live" else 3
+    ref_rounds = (BASELINE_ROUNDS if baseline_source == "live"
+                  else FALLBACK_BASELINE_ROUNDS)
     print(json.dumps({
         "metric": "sim_rounds_per_sec_100nodes",
         "value": round(ours, 2),

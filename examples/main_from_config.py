@@ -25,6 +25,18 @@ def main():
         sys.exit(__doc__)
     cfg = ExperimentConfig.from_json(sys.argv[1])
     state, report = run_experiment(cfg)
+    if isinstance(report, list):  # repetitions > 1: one report per seed
+        import numpy as np
+
+        def last_acc(r):
+            a = r.curves(local=False).get("accuracy")
+            return float(a[-1]) if a is not None and len(a) else float("nan")
+
+        finals = [last_acc(r) for r in report]
+        print(f"[config-run] final global accuracy "
+              f"{np.mean(finals):.4f} ± {np.std(finals):.4f} over "
+              f"{len(report)} repetitions, {cfg.n_rounds} rounds")
+        return
     curves = report.curves(local=False)
     acc = curves.get("accuracy")
     if acc is not None:

@@ -241,6 +241,12 @@ class ExperimentConfig:
     # run
     n_rounds: int = 100
     seed: int = 42
+    repetitions: int = 1  # >1 = vmapped seed batch via run_repetitions
+
+    def __post_init__(self):
+        if self.repetitions < 1:
+            raise ValueError(
+                f"repetitions must be >= 1, got {self.repetitions}")
 
     # -- serialization ------------------------------------------------------
 
@@ -330,10 +336,22 @@ def build_experiment(cfg: ExperimentConfig,
 
 
 def run_experiment(cfg: ExperimentConfig, data: Optional[tuple] = None):
-    """Build and run the experiment; returns ``(state, SimulationReport)``."""
+    """Build and run the experiment.
+
+    Returns ``(state, SimulationReport)``; with ``cfg.repetitions > 1``
+    returns ``(stacked_states, [SimulationReport])`` — the whole seed batch
+    executes as one vmapped program (:meth:`GossipSimulator.run_repetitions`),
+    which is what :func:`gossipy_tpu.utils.plot_evaluation`'s mean±std
+    curves consume.
+    """
+    import jax
+
     from . import set_seed
 
     key = set_seed(cfg.seed)
     sim, _ = build_experiment(cfg, data)
+    if cfg.repetitions > 1:
+        keys = jax.random.split(key, cfg.repetitions)
+        return sim.run_repetitions(cfg.n_rounds, keys)
     state = sim.init_nodes(key)
     return sim.start(state, n_rounds=cfg.n_rounds, key=key)

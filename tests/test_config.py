@@ -97,6 +97,21 @@ class TestRun:
         a2 = r2.curves(local=False)["accuracy"]
         assert np.allclose(a1, a2)
 
+    def test_run_repetitions_batch(self):
+        cfg = tiny_cfg(repetitions=3, n_rounds=5)
+        states, reports = run_experiment(cfg, data=tiny_data())
+        assert len(reports) == 3
+        curves = [r.curves(local=False)["accuracy"] for r in reports]
+        assert all(np.isfinite(c).all() for c in curves)
+        # Different seeds -> different trajectories (vmapped, not copies).
+        # Full curves, not final values: finals quantize to 1/len(test-set)
+        # and can collide across seeds.
+        assert not all(np.allclose(curves[0], c) for c in curves[1:])
+
+    def test_repetitions_must_be_positive(self):
+        with pytest.raises(ValueError, match="repetitions"):
+            tiny_cfg(repetitions=0)
+
     def test_run_with_dataset_name(self):
         cfg = tiny_cfg(dataset="breast", n_nodes=8)
         with warnings.catch_warnings():
