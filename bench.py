@@ -395,27 +395,61 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
     }))
 
 
+def _require_live_backend(timeout: float = 150.0) -> None:
+    """Probe in a disposable child that the jax backend initializes.
+
+    A wedged TPU tunnel hangs ``import jax`` indefinitely; benching must
+    fail fast with a clear error instead of hanging the driver (same
+    pattern as ``__graft_entry__.dryrun_multichip``). A fast non-zero exit
+    (misconfigured jax rather than a hang) surfaces the child's stderr.
+    """
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        sys.exit("[bench] accelerator backend unreachable: jax backend "
+                 f"init still hung after {timeout:.0f}s in a probe "
+                 "subprocess — refusing to hang; fix the TPU tunnel and "
+                 "re-run")
+    if proc.returncode != 0:
+        sys.exit("[bench] jax backend failed to initialize in the probe "
+                 f"subprocess (rc={proc.returncode}); child stderr:\n"
+                 + proc.stderr[-2000:])
+
+
 def main():
-    from gossipy_tpu import enable_compilation_cache
-    enable_compilation_cache()
+    # Parse argv first: usage errors must not pay the backend probe.
+    mode, mode_arg = "north-star", None
     if "--mfu" in sys.argv:
         i = sys.argv.index("--mfu")
         arg = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
-        bench_mfu(max(1, int(arg)) if arg.isdigit() else 50)
-        return
-    if "--scale" in sys.argv:
+        mode, mode_arg = "mfu", max(1, int(arg)) if arg.isdigit() else 50
+    elif "--scale" in sys.argv:
         i = sys.argv.index("--scale")
         arg = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
-        bench_scale(max(2, int(arg)) if arg.isdigit() else 50_000)
-        return
-    X, y = make_data()
-    if "--to-acc" in sys.argv:
+        mode, mode_arg = "scale", max(2, int(arg)) if arg.isdigit() else 50_000
+    elif "--to-acc" in sys.argv:
         try:
-            target = float(sys.argv[sys.argv.index("--to-acc") + 1])
+            mode_arg = float(sys.argv[sys.argv.index("--to-acc") + 1])
         except (IndexError, ValueError):
             sys.exit("usage: python bench.py --to-acc <target accuracy in "
                      "(0, 1]>, e.g. --to-acc 0.95")
-        bench_to_accuracy(X, y, target)
+        mode = "to-acc"
+
+    _require_live_backend()
+    from gossipy_tpu import enable_compilation_cache
+    enable_compilation_cache()
+    if mode == "mfu":
+        bench_mfu(mode_arg)
+        return
+    if mode == "scale":
+        bench_scale(mode_arg)
+        return
+    X, y = make_data()
+    if mode == "to-acc":
+        bench_to_accuracy(X, y, mode_arg)
         return
     ours = bench_ours(X, y)
     baseline_source = "live"

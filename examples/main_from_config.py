@@ -6,44 +6,37 @@ system):
 
     python examples/main_from_config.py examples/configs/spambase_100.json
     python examples/main_from_config.py --dump-default > my_exp.json
+
+Prints the same one-line JSON summary as the other examples (repetitions
+are aggregated as mean finals; ``--plot`` saves the mean±std curves).
 """
 
 from __future__ import annotations
 
-import sys
+import argparse
 
-import _common  # noqa: F401  (inserts the repo root for source checkouts)
+from _common import finish
 
 from gossipy_tpu.config import ExperimentConfig, run_experiment
 
 
 def main():
-    if "--dump-default" in sys.argv:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("config", nargs="?",
+                        help="path to an experiment JSON file")
+    parser.add_argument("--dump-default", action="store_true",
+                        help="print the default config as JSON and exit")
+    parser.add_argument("--plot", default=None, metavar="PATH",
+                        help="save mean±std evaluation curves")
+    args = parser.parse_args()
+    if args.dump_default:
         print(ExperimentConfig().to_json())
         return
-    if len(sys.argv) < 2:
-        sys.exit(__doc__)
-    cfg = ExperimentConfig.from_json(sys.argv[1])
+    if not args.config:
+        parser.error("a config file is required (or --dump-default)")
+    cfg = ExperimentConfig.from_json(args.config)
     state, report = run_experiment(cfg)
-    if isinstance(report, list):  # repetitions > 1: one report per seed
-        import numpy as np
-
-        def last_acc(r):
-            a = r.curves(local=False).get("accuracy")
-            return float(a[-1]) if a is not None and len(a) else float("nan")
-
-        finals = [last_acc(r) for r in report]
-        print(f"[config-run] final global accuracy "
-              f"{np.mean(finals):.4f} ± {np.std(finals):.4f} over "
-              f"{len(report)} repetitions, {cfg.n_rounds} rounds")
-        return
-    curves = report.curves(local=False)
-    acc = curves.get("accuracy")
-    if acc is not None:
-        print(f"[config-run] final global accuracy {float(acc[-1]):.4f} "
-              f"after {cfg.n_rounds} rounds")
-    print(f"[config-run] messages sent {report.sent_messages}, "
-          f"failed {report.failed_messages}")
+    finish(report, args, local=False)
 
 
 if __name__ == "__main__":

@@ -52,8 +52,9 @@ def test_config_runner_smoke(tmp_path):
                      topology="ring", topology_params={"k": 2},
                      batch_size=16, learning_rate=0.3,
                      n_rounds=3).to_json(str(p))
-    out = run_example("main_from_config.py", [str(p)], expect_json=False)
-    assert "final global accuracy" in out
+    summary = run_example("main_from_config.py", [str(p)])
+    assert summary["rounds"] == 3 and summary["repetitions"] == 1
+    assert np.isfinite(summary["final"]["accuracy"])
 
 
 def test_example_repetitions_smoke():
