@@ -395,6 +395,81 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
     }))
 
 
+def bench_fused_regime(rounds: int = 40) -> None:
+    """Pallas ``fused_merge`` in its design regime: CNN-sized params, clique
+    fan-in (every mailbox slot regularly occupied), MERGE_UPDATE deliver.
+
+    Round 1 measured the kernel level with XLA on the 20-regular spambase
+    config (254 vs 247 ms/round); this mode answers whether the kernel wins
+    where the gather materialization actually dominates, or should be
+    retired to documentation. Prints ONE JSON line with both timings.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import CIFAR10Net
+    from gossipy_tpu.simulation import GossipSimulator
+
+    n = 64
+    rng = np.random.default_rng(0)
+    Xtr = rng.normal(size=(n * 64, 32, 32, 3)).astype(np.float32)
+    ytr = rng.integers(0, 10, n * 64)
+    disp = DataDispatcher(ClassificationDataHandler(Xtr, ytr, test_size=0.2),
+                          n=n, eval_on_user=False)
+    handler = SGDHandler(
+        model=CIFAR10Net(), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.05), local_epochs=1, batch_size=32,
+        n_classes=10, input_shape=(32, 32, 3),
+        create_model_mode=CreateModelMode.MERGE_UPDATE,
+        compute_dtype=jnp.bfloat16)
+
+    def run(fused: bool) -> float:
+        sim = GossipSimulator(handler, Topology.clique(n), disp.stacked(),
+                              delta=ROUND_LEN,
+                              protocol=AntiEntropyProtocol.PUSH,
+                              eval_every=rounds, fused_merge=fused)
+        key = jax.random.PRNGKey(0)
+        state = sim.init_nodes(key, common_init=True)
+        s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
+        jax.block_until_ready(s2.model.params)
+        t0 = time.perf_counter()
+        s3, _ = sim.start(state, n_rounds=rounds, key=key)
+        jax.block_until_ready(s3.model.params)
+        return (time.perf_counter() - t0) / rounds * 1e3  # ms/round
+
+    plain_ms = run(False)
+    fused_ms = None
+    err = None
+    if jax.default_backend() != "tpu":
+        err = "fused path skipped off-TPU (pallas interpreter mode is not a meaningful timing)"
+    else:
+        try:
+            fused_ms = run(True)
+        except Exception as e:  # kernel unavailable on this backend
+            err = repr(e)[:200]
+    print(f"[fused-regime] CNN clique-{n}: plain {plain_ms:.1f} ms/round, "
+          f"fused {fused_ms if fused_ms is None else round(fused_ms, 1)} "
+          f"ms/round" + (f" (error: {err})" if err else ""), file=sys.stderr)
+    speedup = (plain_ms / fused_ms) if fused_ms else None
+    print(json.dumps({
+        "metric": "fused_merge_speedup_cnn_clique",
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x_vs_xla_gather_blend",
+        "vs_baseline": round(speedup, 3) if speedup else None,
+        "raw": {
+            "plain_ms_per_round": round(plain_ms, 2),
+            "fused_ms_per_round": (round(fused_ms, 2)
+                                   if fused_ms is not None else None),
+            "n_nodes": n, "topology": "clique", "rounds": rounds,
+            "error": err,
+        },
+    }))
+
+
 def _require_live_backend(timeout: float = 150.0) -> None:
     """Probe in a disposable child that the jax backend initializes.
 
@@ -430,6 +505,10 @@ def main():
         i = sys.argv.index("--scale")
         arg = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
         mode, mode_arg = "scale", max(2, int(arg)) if arg.isdigit() else 50_000
+    elif "--fused-regime" in sys.argv:
+        i = sys.argv.index("--fused-regime")
+        arg = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
+        mode, mode_arg = "fused", max(1, int(arg)) if arg.isdigit() else 40
     elif "--to-acc" in sys.argv:
         try:
             mode_arg = float(sys.argv[sys.argv.index("--to-acc") + 1])
@@ -446,6 +525,9 @@ def main():
         return
     if mode == "scale":
         bench_scale(mode_arg)
+        return
+    if mode == "fused":
+        bench_fused_regime(mode_arg)
         return
     X, y = make_data()
     if mode == "to-acc":
