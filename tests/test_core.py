@@ -53,6 +53,71 @@ def test_random_regular_and_ba():
     assert (np.asarray(ba.adjacency) == np.asarray(ba.adjacency).T).all()
 
 
+def test_auto_backend_switch_warns(caplog):
+    """backend='auto' silently changing the RNG stream above the native
+    threshold is a reproducibility foot-gun; it must log loudly."""
+    import logging
+
+    from gossipy_tpu import LOG, native
+
+    if not native.available():
+        pytest.skip("native graphgen unavailable")
+    n = Topology.NATIVE_THRESHOLD
+    # The package logger carries a process-global DuplicateFilter; lift it
+    # so this test does not depend on being the first emitter.
+    saved = LOG.filters[:]
+    for f in saved:
+        LOG.removeFilter(f)
+    try:
+        with caplog.at_level(logging.WARNING, logger="gossipy_tpu"):
+            Topology.random_regular(n, 4, seed=1, backend="auto")
+        assert any("backend='auto'" in r.getMessage() for r in caplog.records)
+        # Explicit pins stay quiet.
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="gossipy_tpu"):
+            Topology.random_regular(64, 4, seed=1, backend="networkx")
+            Topology.random_regular(64, 4, seed=1, backend="native")
+        assert not [r for r in caplog.records if "backend" in r.getMessage()]
+    finally:
+        for f in saved:
+            LOG.addFilter(f)
+
+
+def test_backends_learning_quality_band(key):
+    """Edge sets differ between networkx and native generators (documented),
+    but a gossip run over either must land in the same quality band."""
+    import optax
+
+    from gossipy_tpu import native
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    if not native.available():
+        pytest.skip("native graphgen unavailable")
+    rng = np.random.default_rng(0)
+    d, n = 8, 32
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n * 12, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.25),
+                          n=n)
+    handler = SGDHandler(model=LogisticRegression(d, 2),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
+                         local_epochs=1, batch_size=8, n_classes=2,
+                         input_shape=(d,))
+    accs = {}
+    for backend in ("networkx", "native"):
+        topo = Topology.random_regular(n, 6, seed=3, backend=backend)
+        sim = GossipSimulator(handler, topo, disp.stacked(), delta=10)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=12, key=key)
+        accs[backend] = float(rep.curves(local=False)["accuracy"][-1])
+    assert all(a > 0.8 for a in accs.values()), accs
+    assert abs(accs["networkx"] - accs["native"]) < 0.1, accs
+
+
 def test_sample_peers_respects_adjacency(key):
     t = Topology.ring(8, k=1)
     for i in range(20):
