@@ -159,6 +159,73 @@ def ring_mixed_matmul(w: jax.Array, x: jax.Array, mesh: Mesh,
     return body(w, x)
 
 
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis_name=None, causal: bool = False) -> jax.Array:
+    """Sequence-parallel attention over a ppermute ring (blockwise softmax).
+
+    ``q``/``k``/``v`` are ``[S, D]`` with the SEQUENCE axis sharded over the
+    mesh; each device keeps its query block resident while key/value blocks
+    rotate around the ring, maintaining the streaming-softmax statistics
+    ``(running max, normalizer, weighted-value accumulator)`` per hop — so
+    no device ever materializes the ``[S, S]`` score matrix or the full
+    key/value sequence (peak per-device memory is ``S/d`` rows). Compute
+    pipelines against the next hop's ICI transfer exactly like
+    :func:`ring_mixed_matmul`.
+
+    The reference has no sequence models (SURVEY §2.12/§5 — nothing to
+    port); this primitive exists to show the explicit comm backend
+    generalizes beyond the gossip exchange to long-context sequence
+    parallelism (the public "ring attention" schedule). ``causal=True``
+    masks by GLOBAL position (device-block offsets included). Heads/batch:
+    ``jax.vmap`` this over leading axes.
+    """
+    axis_name = _node_axis_entry(mesh, axis_name)
+    d = _axis_size(mesh, axis_name)
+    s_len, dim = q.shape
+    assert k.shape == (s_len, dim), \
+        f"k {k.shape} must match q {(s_len, dim)}"
+    assert v.shape[0] == s_len, \
+        f"v has {v.shape[0]} rows, expected {s_len}"
+    assert s_len % d == 0, f"sequence {s_len} not divisible by mesh axis {d}"
+    sl = s_len // d
+    dv = v.shape[1]
+    scale = 1.0 / np.sqrt(dim)
+    NEG = jnp.asarray(-1e30, jnp.float32)  # finite: exp() stays nan-free
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(axis_name, None),) * 3,
+             out_specs=P(axis_name, None))
+    def body(q_l, k_l, v_l):
+        me = jax.lax.axis_index(axis_name)
+        q_pos = me * sl + jnp.arange(sl)
+        qf = q_l.astype(jnp.float32)
+
+        def hop(s_idx, carry, kv):
+            m, l, acc = carry
+            src = (me + s_idx) % d
+            k_c = kv[:, :dim]
+            v_c = kv[:, dim:]
+            s = (qf @ k_c.T.astype(jnp.float32)) * scale  # [sl, sl]
+            if causal:
+                k_pos = src * sl + jnp.arange(sl)
+                s = jnp.where(k_pos[None, :] > q_pos[:, None], NEG, s)
+            m_new = jnp.maximum(m, s.max(axis=1))
+            alpha = jnp.exp(m - m_new)            # rescale old statistics
+            p = jnp.exp(s - m_new[:, None])       # [sl, sl]
+            acc = acc * alpha[:, None] + p @ v_c.astype(jnp.float32)
+            l = l * alpha + p.sum(axis=1)
+            return m_new, l, acc
+
+        kv0 = jnp.concatenate([k_l, v_l], axis=1)
+        m0 = jnp.full((sl,), NEG, jnp.float32)
+        l0 = jnp.zeros((sl,), jnp.float32)
+        acc0 = jnp.zeros((sl, dv), jnp.float32)
+        m, l, acc = _ring_hops(d, axis_name, hop, ((m0, l0, acc0), kv0))
+        return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q.dtype)
+
+    return body(q, k, v)
+
+
 def ring_mix_pytree(w: jax.Array, params, mesh: Mesh,
                     axis_name=None):
     """:func:`ring_mixed_matmul` over a stacked ``[N, ...]`` params pytree
