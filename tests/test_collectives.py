@@ -206,6 +206,25 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
                                    atol=1e-5)
 
+    def test_rolled_loop(self, mesh, monkeypatch):
+        """Pods ring through the fori_loop path (> _UNROLL_MAX devices):
+        the (m, l, acc) carry crosses the pcast varying-axes fix-up and the
+        causal mask uses a traced hop index — force the path on 8 devices."""
+        from gossipy_tpu.parallel import collectives
+        monkeypatch.setattr(collectives, "_UNROLL_MAX", 2)
+        rng = np.random.default_rng(4)
+        s_len, d = 24, 8
+        q = rng.normal(size=(s_len, d)).astype(np.float32)
+        k = rng.normal(size=(s_len, d)).astype(np.float32)
+        v = rng.normal(size=(s_len, d)).astype(np.float32)
+        for causal in (False, True):
+            got = collectives.ring_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
+                causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(got), dense_attention(q, k, v, causal=causal),
+                rtol=1e-5, atol=1e-5)
+
     def test_under_jit(self, mesh):
         from gossipy_tpu.parallel.collectives import ring_attention
         rng = np.random.default_rng(3)
