@@ -267,6 +267,7 @@ class GossipSimulator(SimulationEventSender):
         self.data = {k: jnp.asarray(v) for k, v in data.items()}
         self.has_local_test = "xte" in data
         self.has_global_eval = "x_eval" in data
+        self._warn_if_eval_memory_large()
         self._message_size = message_size
         self._metric_names: Optional[list[str]] = None
         self._jit_cache: dict = {}
@@ -333,6 +334,40 @@ class GossipSimulator(SimulationEventSender):
                 f"worst-case expected same-round fan-in {lam_max:.1f} gives "
                 f"~{p_over:.1%} per-node-round message loss (counted as "
                 f"'failed'). Raise mailbox_slots to silence.")
+
+    def _n_eval_nodes(self) -> int:
+        """How many nodes an evaluation pass materializes (the static
+        ``sampling_eval`` subset size, or the full population). Shared by
+        ``_eval_phase`` and the construction-time memory estimate so the
+        two cannot drift."""
+        if self.sampling_eval > 0:
+            return max(int(self.n_nodes * self.sampling_eval), 1)
+        return self.n_nodes
+
+    def _warn_if_eval_memory_large(self) -> None:
+        """Warn when the global-evaluation score tensor will be huge.
+
+        Global eval materializes ``[eval-nodes, eval-samples, ...]``
+        intermediates (scores + the AUC sort); at 50k nodes an uncapped 20%
+        eval split is a ~16 GB tensor — OOM on a single chip, discovered
+        the hard way by ``bench.py --scale``. Estimate the peak and point
+        at the two knobs (``sampling_eval``, a smaller eval set) before the
+        user pays a compile to find out.
+        """
+        if not self.has_global_eval:
+            return
+        n_eval_nodes = self._n_eval_nodes()
+        n_samples = int(self.data["x_eval"].shape[0])
+        # Scores + the paired sort operands: ~3 [nodes, samples] f32 buffers.
+        est_bytes = 3 * n_eval_nodes * n_samples * 4
+        if est_bytes > 2 << 30:
+            import warnings
+            warnings.warn(
+                f"global evaluation materializes ~[{n_eval_nodes} nodes x "
+                f"{n_samples} samples] intermediates "
+                f"(~{est_bytes / 2**30:.1f} GB) — likely OOM on one chip. "
+                f"Use sampling_eval= to evaluate a node subset and/or a "
+                f"smaller eval split.")
 
     def _local_data(self):
         return (self.data["xtr"], self.data["ytr"], self.data["mtr"])
@@ -752,7 +787,7 @@ class GossipSimulator(SimulationEventSender):
         # (reference simul.py:433-436).
         if self.sampling_eval > 0:
             k_eval = self._round_key(base_key, r, _K_EVAL)
-            n_pick = max(int(n * self.sampling_eval), 1)
+            n_pick = self._n_eval_nodes()
             idx = jax.random.permutation(k_eval, n)[:n_pick]
             model = jax.tree.map(lambda l: l[idx], state.model)
         else:

@@ -1,0 +1,80 @@
+"""Regression coverage for bench.py modes that run off the driver path."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    import bench as b  # conftest puts the repo root on sys.path
+    return b
+
+
+def last_json(capfd):
+    out, _ = capfd.readouterr()
+    return json.loads([l for l in out.strip().splitlines()
+                       if l.startswith("{")][-1])
+
+
+def test_scale_small_n_keeps_fractional_split(bench, capfd):
+    """The 2048-sample eval cap is a cap, not a floor: small --scale runs
+    must keep a valid (<1.0) test fraction instead of crashing."""
+    bench.bench_scale(64, rounds=2)
+    row = last_json(capfd)
+    assert row["metric"] == "sim_rounds_per_sec_64nodes"
+    assert np.isfinite(row["raw"]["final_global_accuracy"])
+    assert row["raw"]["backend"] in ("cpu", "tpu")
+
+
+def test_scale_reports_backend_and_build_time(bench, capfd):
+    bench.bench_scale(256, rounds=2)
+    row = last_json(capfd)
+    assert row["unit"] == "rounds/s" and row["value"] > 0
+    assert row["raw"]["topology_build_seconds"] >= 0
+
+
+def test_eval_memory_warning_fires_at_scale_trap():
+    """The engine warns at construction for the [nodes x samples] eval
+    blow-up the scale bench hit (16 GB at 50k nodes x 40k samples)."""
+    import optax
+
+    from gossipy_tpu.core import SparseTopology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    rng = np.random.default_rng(0)
+    d, n = 4, 4096
+    X = rng.normal(size=(8 * n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    handler = SGDHandler(model=LogisticRegression(d, 2),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
+                         local_epochs=1, batch_size=4, n_classes=2,
+                         input_shape=(d,))
+    topo = SparseTopology.ring(n, 2)
+    # 4096 nodes x ~6554 eval samples x 3 f32 buffers ~= 0.3 GB -> quiet;
+    # scale the estimate into warning range via full-population eval of a
+    # large synthetic eval split by faking more nodes is expensive, so
+    # instead check both sides around the 2 GB threshold directly.
+    disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.2),
+                          n=n, eval_on_user=False)
+    data = disp.stacked()
+    import warnings as w
+    with w.catch_warnings():
+        w.simplefilter("error", UserWarning)  # below threshold: stay quiet
+        GossipSimulator(handler, topo, data, delta=10)
+
+    sim = GossipSimulator.__new__(GossipSimulator)  # threshold math only
+    sim.has_global_eval = True
+    sim.n_nodes = 50_000
+    sim.sampling_eval = 0.0
+    sim.data = {"x_eval": np.zeros((40_000, 1), np.float32)}
+    with pytest.warns(UserWarning, match="likely OOM"):
+        sim._warn_if_eval_memory_large()
+    sim.sampling_eval = 0.01  # the fix: 500 eval nodes -> quiet
+    with w.catch_warnings():
+        w.simplefilter("error", UserWarning)
+        sim._warn_if_eval_memory_large()
