@@ -201,12 +201,13 @@ class ExperimentConfig:
     """
 
     # data
-    dataset: str = "spambase"
-    n_nodes: int = 100
+    dataset: str = "spambase"            # classification names, or the
+    n_nodes: int = 100                   # image sets "cifar10"/"fashion_mnist"
     assignment: str = "uniform"          # AssignmentHandler method name
     assignment_params: dict = dataclasses.field(default_factory=dict)
     eval_on_user: bool = False
-    test_size: float = 0.2
+    test_size: float = 0.2               # tabular split (images ship a test set)
+    subsample: int = 0                   # cap train samples (0 = all)
     # model + handler
     model: str = "logreg"
     model_params: dict = dataclasses.field(default_factory=dict)
@@ -302,13 +303,35 @@ def build_experiment(cfg: ExperimentConfig,
         raise ValueError(f"unknown simulator {cfg.simulator!r}; "
                          f"options: {sorted(known)}")
 
-    if data is None:
-        X, y = load_classification_dataset(cfg.dataset)
+    def subsample(X, y, n):
+        # Seeded shuffle BEFORE slicing: several loaders return rows sorted
+        # by class (sklearn iris/wine), where a prefix slice would silently
+        # produce single-class data.
+        order = np.random.default_rng(cfg.seed).permutation(len(X))[:n]
+        return X[order], y[order]
+
+    image_sets = {"cifar10": "get_CIFAR10", "fashion_mnist": "get_FashionMNIST"}
+    if data is None and cfg.dataset in image_sets:
+        from . import data as data_mod
+        (Xtr, ytr), (Xte, yte) = getattr(data_mod, image_sets[cfg.dataset])()
+        if cfg.subsample:
+            Xtr, ytr = subsample(Xtr, ytr, cfg.subsample)
+            Xte, yte = subsample(Xte, yte, cfg.subsample // 5 or 1)
+        # Normalize both splits with TRAIN statistics (the flagship
+        # examples/main_cifar10_100nodes.py recipe).
+        mu, sd = Xtr.mean(), Xtr.std() + 1e-8
+        X = (Xtr - mu) / sd
+        dh = ClassificationDataHandler(X, ytr, (Xte - mu) / sd, yte)
+        # A small subsample may miss classes; count over both splits.
+        y = np.concatenate([ytr, yte])
     else:
-        X, y = data
+        X, y = data if data is not None \
+            else load_classification_dataset(cfg.dataset)
+        if cfg.subsample:
+            X, y = subsample(X, y, cfg.subsample)
+        dh = ClassificationDataHandler(X, y, test_size=cfg.test_size,
+                                       seed=cfg.seed)
     n_classes = int(np.max(y)) + 1
-    dh = ClassificationDataHandler(X, y, test_size=cfg.test_size,
-                                   seed=cfg.seed)
     assignment = None
     if cfg.assignment != "uniform":
         if not hasattr(AssignmentHandler, cfg.assignment):

@@ -112,6 +112,30 @@ class TestRun:
         with pytest.raises(ValueError, match="repetitions"):
             tiny_cfg(repetitions=0)
 
+    def test_image_dataset_cnn_builds_and_steps(self):
+        """The flagship CIFAR config is expressible as JSON: image dataset
+        + CNN + Dirichlet split, subsampled to smoke scale."""
+        cfg = ExperimentConfig(
+            dataset="cifar10", n_nodes=4, model="cifar10net",
+            assignment="label_dirichlet_skew",
+            assignment_params={"beta": 0.5}, subsample=120,
+            topology="ring", topology_params={"k": 1}, delta=10,
+            batch_size=16, learning_rate=0.05, n_rounds=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, report = run_experiment(cfg)
+        assert np.isfinite(report.curves(local=False)["accuracy"][-1])
+
+    def test_shipped_configs_parse_and_validate(self):
+        import glob
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = glob.glob(os.path.join(repo, "examples", "configs", "*.json"))
+        assert len(paths) >= 2
+        for p in paths:
+            cfg = ExperimentConfig.from_json(p)
+            assert cfg.n_nodes > 0
+
     def test_run_with_dataset_name(self):
         cfg = tiny_cfg(dataset="breast", n_nodes=8)
         with warnings.catch_warnings():
