@@ -32,6 +32,10 @@ from gossipy_tpu.flow_control import GeneralizedTokenAccount, \
 from gossipy_tpu.handlers import KMeansHandler, MFHandler
 from gossipy_tpu.simulation import GossipSimulator
 
+# Everything here compares against the torch reference; opt-in second lane
+# (`pytest -m parity`) so the default lane stays fast.
+pytestmark = pytest.mark.parity
+
 
 
 def _run_ref_sim(sim, rounds, metric="accuracy", local=False, start_args=()):
