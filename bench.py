@@ -438,6 +438,73 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
     })
 
 
+def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
+    """Variant scale row: Koloskova All-to-All (mixing merge) rounds/sec at
+    ``n_nodes`` over a :class:`SparseTopology` with O(E) ``SparseMixing``
+    edge weights — the round-3 segment-sum path. The reference's
+    ``MixingMatrix``/``All2AllGossipSimulator`` (core.py:392-453,
+    simul.py:720-852) are dense-only on top of a per-object Python loop, so
+    no reference number exists at this node count.
+    """
+    import jax
+    import optax
+
+    from gossipy_tpu.core import CreateModelMode, SparseTopology, \
+        uniform_mixing
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import WeightedSGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import All2AllGossipSimulator
+
+    d = 57
+    rng = np.random.default_rng(42)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(4 * n_nodes, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    eval_cap = min(2048, int(0.2 * len(X)))  # see bench_scale
+    disp = DataDispatcher(
+        ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
+        n=n_nodes, eval_on_user=False)
+    handler = WeightedSGDHandler(
+        model=LogisticRegression(d, 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.1), local_epochs=1, batch_size=4, n_classes=2,
+        input_shape=(d,), create_model_mode=CreateModelMode.MERGE_UPDATE)
+    t0 = time.perf_counter()
+    topo = SparseTopology.random_regular(n_nodes, DEGREE, seed=42)
+    mixing = uniform_mixing(topo)
+    build_s = time.perf_counter() - t0
+    sim = All2AllGossipSimulator(handler, topo, disp.stacked(),
+                                 delta=ROUND_LEN, mixing=mixing,
+                                 sampling_eval=0.01, eval_every=rounds)
+    key = jax.random.PRNGKey(42)
+    state = sim.init_nodes(key)
+    s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
+    jax.block_until_ready(s2.model.params)
+    t0 = time.perf_counter()
+    s3, report = sim.start(state, n_rounds=rounds, key=key)
+    jax.block_until_ready(s3.model.params)
+    elapsed = time.perf_counter() - t0
+    acc = report.curves(local=False)["accuracy"][-1]
+    print(f"[scale-all2all] {n_nodes} nodes: build {build_s:.2f}s, {rounds} "
+          f"rounds in {elapsed:.2f}s ({rounds / elapsed:.1f} r/s), "
+          f"final acc {acc:.3f}", file=sys.stderr)
+    emit({
+        "metric": f"all2all_rounds_per_sec_{n_nodes}nodes",
+        "value": round(rounds / elapsed, 2),
+        "unit": "rounds/s",
+        "vs_baseline": None,
+        "raw": {
+            "n_nodes": n_nodes,
+            "degree": DEGREE,
+            "rounds": rounds,
+            "topology_and_mixing_build_seconds": round(build_s, 2),
+            "final_global_accuracy": round(float(acc), 4),
+            "note": "sparse (segment-sum) mixing merge; the reference's "
+                    "All2All simulator is dense-only Python",
+        },
+    })
+
+
 def bench_fused_regime(rounds: int = 40) -> None:
     """Pallas ``fused_merge`` in its design regime: CNN-sized params, clique
     fan-in (every mailbox slot regularly occupied), MERGE_UPDATE deliver.
@@ -593,6 +660,9 @@ def main():
     mode, mode_arg = "north-star", None
     if "--mfu" in sys.argv:
         mode, mode_arg = "mfu", _mode_arg("--mfu", default=50, minimum=1)
+    elif "--scale-all2all" in sys.argv:
+        mode, mode_arg = "scale-all2all", _mode_arg(
+            "--scale-all2all", default=50_000, minimum=2)
     elif "--scale" in sys.argv:
         mode, mode_arg = "scale", _mode_arg("--scale", default=50_000,
                                             minimum=2)
@@ -616,6 +686,9 @@ def main():
         return
     if mode == "scale":
         bench_scale(mode_arg)
+        return
+    if mode == "scale-all2all":
+        bench_scale_all2all(mode_arg)
         return
     if mode == "fused":
         bench_fused_regime(mode_arg)

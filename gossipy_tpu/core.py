@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 from enum import IntEnum
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -378,13 +378,47 @@ class LinearDelay(Delay):
 # Mixing matrices (all-to-all decentralized SGD, Koloskova et al. 2020)
 # ---------------------------------------------------------------------------
 
-def uniform_mixing(topology: Topology) -> jnp.ndarray:
-    """Dense [N, N] uniform mixing matrix.
+class SparseMixing(NamedTuple):
+    """Mixing weights in edge-list (CSR-aligned) form, O(E) memory.
 
-    Row i weights node i and each of its deg(i) peers by 1/(deg(i)+1) —
-    the matrix form of ``UniformMixing.get`` (reference core.py:419-434),
-    which returns the per-node weight vector [self] + peers.
+    The dense [N, N] mixing matrix is the scale wall of the All-to-All
+    simulator (the reference's ``MixingMatrix`` family, core.py:392-453, is
+    dense-only); over a :class:`SparseTopology` the same weights live on the
+    directed edge list instead: ``edge_w[e]`` is W[rows[e], senders[e]] for
+    the 2E directed edges of the CSR structure, ``self_w[i]`` is W[i, i].
+    The All2All merge becomes a gather + ``segment_sum`` instead of an
+    einsum.
     """
+
+    edge_w: jnp.ndarray    # [2E] float32, W[receiver, sender] per edge
+    self_w: jnp.ndarray    # [N]  float32, W[i, i]
+    rows: jnp.ndarray      # [2E] int32, receiver (CSR row) per edge
+    senders: jnp.ndarray   # [2E] int32, sender (CSR indices) per edge
+    num_nodes: int
+
+
+def _csr_edge_arrays(topo: "SparseTopology"):
+    rows = np.repeat(np.arange(topo.num_nodes, dtype=np.int32),
+                     np.asarray(topo.degrees))
+    return rows, topo.indices
+
+
+def uniform_mixing(topology) -> jnp.ndarray:
+    """Uniform mixing weights: row i weights node i and each of its deg(i)
+    peers by 1/(deg(i)+1) — the matrix form of ``UniformMixing.get``
+    (reference core.py:419-434), which returns the per-node weight vector
+    [self] + peers.
+
+    Dense :class:`Topology` -> dense [N, N] matrix; :class:`SparseTopology`
+    -> :class:`SparseMixing` edge weights (O(E), no [N, N] anywhere).
+    """
+    if isinstance(topology, SparseTopology):
+        rows, senders = _csr_edge_arrays(topology)
+        inv = 1.0 / (np.asarray(topology.degrees, dtype=np.float64) + 1.0)
+        return SparseMixing(jnp.asarray(inv[rows], dtype=jnp.float32),
+                            jnp.asarray(inv, dtype=jnp.float32),
+                            jnp.asarray(rows), jnp.asarray(senders),
+                            topology.num_nodes)
     a = topology.adjacency.astype(np.float64)
     deg = a.sum(axis=1)
     w = a / (deg[:, None] + 1.0)
@@ -392,15 +426,28 @@ def uniform_mixing(topology: Topology) -> jnp.ndarray:
     return jnp.asarray(w, dtype=jnp.float32)
 
 
-def metropolis_hastings_mixing(topology: Topology) -> jnp.ndarray:
-    """Dense [N, N] Metropolis-Hastings mixing matrix (symmetric, doubly stochastic).
+def metropolis_hastings_mixing(topology) -> jnp.ndarray:
+    """Metropolis-Hastings mixing weights (symmetric, doubly stochastic).
 
     W_ij = 1 / (1 + max(deg_i, deg_j)) for edges, W_ii = 1 - sum_j W_ij.
     The reference's ``MetropolisHastingsMixing`` (core.py:437-453) computes
     ``[1/deg_i] + [1/(min(deg_k, deg_i)+1)]`` whose rows do not sum to 1 and
     which inherits the node-0 degree bug; we implement the standard
     (convergent) MH weights instead — an intentional, documented divergence.
+
+    Dense :class:`Topology` -> dense [N, N] matrix; :class:`SparseTopology`
+    -> :class:`SparseMixing` edge weights (O(E)).
     """
+    if isinstance(topology, SparseTopology):
+        rows, senders = _csr_edge_arrays(topology)
+        deg = np.asarray(topology.degrees, dtype=np.float64)
+        ew = 1.0 / (1.0 + np.maximum(deg[rows], deg[senders]))
+        self_w = 1.0 - np.bincount(rows, weights=ew,
+                                   minlength=topology.num_nodes)
+        return SparseMixing(jnp.asarray(ew, dtype=jnp.float32),
+                            jnp.asarray(self_w, dtype=jnp.float32),
+                            jnp.asarray(rows), jnp.asarray(senders),
+                            topology.num_nodes)
     a = topology.adjacency.astype(np.float64)
     deg = a.sum(axis=1)
     denom = 1.0 + np.maximum(deg[:, None], deg[None, :])

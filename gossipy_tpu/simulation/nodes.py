@@ -117,15 +117,27 @@ class CacheNeighGossipSimulator(GossipSimulator):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        # slot_of[i, j] = slot index of neighbor j at node i (-1 if none).
+        # nbr_table[i, s] = neighbor id in slot s of node i (-1 = unused).
+        # O(N * max_deg) — the same footprint as the per-neighbor model cache
+        # itself, so a SparseTopology CacheNeigh run scales to the node
+        # counts the vanilla engine reaches (a dense [N, N] slot_of table,
+        # the round-2 design, was the one remaining N^2 object here).
+        from ..core import SparseTopology
         n = self.n_nodes
-        slot_of = np.full((n, n), -1, dtype=np.int32)
-        max_deg = int(self.topology.degrees.max()) if n else 0
-        for i in range(n):
-            for s, j in enumerate(self.topology.get_peers(i)):
-                slot_of[i, j] = s
+        degrees = np.asarray(self.topology.degrees)
+        max_deg = int(degrees.max()) if n else 0
         self.max_deg = max(max_deg, 1)
-        self.slot_of = jnp.asarray(slot_of)
+        nbr_table = np.full((n, self.max_deg), -1, dtype=np.int32)
+        if isinstance(self.topology, SparseTopology):
+            rows = np.repeat(np.arange(n), degrees)
+            pos = np.arange(len(self.topology.indices)) \
+                - self.topology.indptr[rows]
+            nbr_table[rows, pos] = self.topology.indices
+        elif n:
+            i, j = np.nonzero(np.asarray(self.topology.adjacency))
+            pos = np.arange(len(i)) - np.searchsorted(i, i, side="left")
+            nbr_table[i, pos] = j
+        self.nbr_table = jnp.asarray(nbr_table)
 
     def _init_aux(self, model: ModelState, key: jax.Array):
         S = self.max_deg
@@ -142,9 +154,12 @@ class CacheNeighGossipSimulator(GossipSimulator):
     def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
                        call_key) -> SimState:
         # Park the model in the sender's slot instead of merging (node.py:476-485).
-        sender_slot = extra  # we smuggle the sender id via extra; see below
-        slot = self.slot_of[jnp.arange(self.n_nodes), jnp.clip(sender_slot, 0,
-                                                               self.n_nodes - 1)]
+        sender = extra  # we smuggle the sender id via extra; see below
+        # Slot lookup: position of the sender in the receiver's padded
+        # neighbor row — O(max_deg) scan per node, no [N, N] table.
+        match = self.nbr_table == sender[:, None]  # [N, max_deg]
+        slot = jnp.where(match.any(axis=1),
+                         jnp.argmax(match, axis=1), -1).astype(jnp.int32)
         ok = valid & (slot >= 0)
         slot_c = jnp.clip(slot, 0, self.max_deg - 1)
         idx = jnp.arange(self.n_nodes)

@@ -181,17 +181,27 @@ class All2AllGossipSimulator(GossipSimulator):
     rotation and no device materializes the full stacked params.
     """
 
-    def __init__(self, *args, mixing: jax.Array, mesh=None,
+    def __init__(self, *args, mixing, mesh=None,
                  ring_mix: bool = False, **kwargs):
+        from ..core import SparseMixing
         kwargs.setdefault("protocol", AntiEntropyProtocol.PUSH)
         super().__init__(*args, **kwargs)
         assert self.protocol == AntiEntropyProtocol.PUSH, \
             "All2AllNode only supports PUSH protocol."  # node.py:856-858
-        self.mixing = jnp.asarray(mixing, dtype=jnp.float32)
+        self.sparse_mix = isinstance(mixing, SparseMixing)
+        if self.sparse_mix:
+            assert mixing.num_nodes == self.n_nodes, \
+                "mixing/topology node-count mismatch"
+            self.mixing = mixing
+        else:
+            self.mixing = jnp.asarray(mixing, dtype=jnp.float32)
         self.mesh = mesh
         self.ring_mix = bool(ring_mix)
         if self.ring_mix:
             assert mesh is not None, "ring_mix=True requires a mesh"
+            assert not self.sparse_mix, \
+                "ring_mix schedules the dense mixing matmul; use the " \
+                "segment-sum sparse path without a ring"
             # Ring over the same axes the node dimension is sharded on — all
             # mesh axes combined on a 2-D (dcn, nodes) mesh, matching
             # parallel.shard_state's placement.
@@ -207,38 +217,79 @@ class All2AllGossipSimulator(GossipSimulator):
         n = self.n_nodes
         fires, _ = self._fire_mask(state, r)
 
-        # Per-edge liveness: sender fired, message not dropped, receiver online.
-        drop = jax.random.bernoulli(
-            self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob, (n, n))
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_A2A_ONLINE), self.online_prob, (n,))
-        adj = self.topology.adjacency_dev
-        live = adj & fires[None, :] & ~drop & online[:, None]  # [recv, sender]
-
-        w = self.mixing * live
-        w = w + jnp.diag(jnp.diag(self.mixing))  # self weight always present
-        row_sum = w.sum(axis=1, keepdims=True)
-        w_eff = w / jnp.maximum(row_sum, 1e-12)
-
-        n_sent = (adj & fires[None, :]).sum()
-        n_failed = (adj & fires[None, :] & (drop | ~online[:, None])).sum()
-        size = self._model_size(state.model.params)
-
-        # The mixing merge: one matmul per parameter leaf — dense einsum, or
-        # the explicit shard_map+ppermute ring schedule over the mesh.
-        if self.ring_mix:
-            from ..parallel.collectives import ring_mix_pytree
+        if self.sparse_mix:
+            # O(E) formulation over the CSR edge list: liveness, row
+            # renormalization and the merge itself are gathers +
+            # segment-sums — no [N, N] tensor exists at any point.
+            mix = self.mixing
+            n_edges = mix.rows.shape[0]
+            drop_e = jax.random.bernoulli(
+                self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob,
+                (n_edges,))
+            sent_e = fires[mix.senders]
+            live_e = sent_e & ~drop_e & online[mix.rows]
+            w_e = mix.edge_w * live_e
+            row_sum = mix.self_w + jax.ops.segment_sum(w_e, mix.rows, n)
+            inv = 1.0 / jnp.maximum(row_sum, 1e-12)
+            w_e_eff = w_e * inv[mix.rows]
+            self_eff = mix.self_w * inv
 
             def mix_tree(params):
-                return ring_mix_pytree(w_eff, params, self.mesh,
-                                       self._ring_axis)
+                def leaf(p):
+                    flat = p.reshape(n, -1)
+                    contrib = w_e_eff[:, None] * flat[mix.senders]
+                    out = self_eff[:, None] * flat + \
+                        jax.ops.segment_sum(contrib, mix.rows, n)
+                    return out.reshape(p.shape)
+                return jax.tree.map(leaf, params)
+
+            n_sent = sent_e.sum()
+            n_failed = (sent_e & (drop_e | ~online[mix.rows])).sum()
+            received_any = jax.ops.segment_max(
+                (live_e & (mix.edge_w > 0)).astype(jnp.int32), mix.rows, n) > 0
+
+            def age_max(n_updates):
+                return jax.ops.segment_max(
+                    jnp.where(live_e, n_updates[mix.senders], 0), mix.rows, n)
         else:
-            def mix_tree(params):
-                return jax.tree.map(
-                    lambda p: (w_eff @ p.reshape(n, -1)).reshape(p.shape),
-                    params)
+            # Per-edge liveness: sender fired, message not dropped, receiver
+            # online.
+            drop = jax.random.bernoulli(
+                self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob,
+                (n, n))
+            adj = self.topology.adjacency_dev
+            live = adj & fires[None, :] & ~drop & online[:, None]  # [recv, sender]
 
-        received_any = (live & (self.mixing > 0)).any(axis=1)
+            w = self.mixing * live
+            w = w + jnp.diag(jnp.diag(self.mixing))  # self weight always present
+            row_sum = w.sum(axis=1, keepdims=True)
+            w_eff = w / jnp.maximum(row_sum, 1e-12)
+
+            n_sent = (adj & fires[None, :]).sum()
+            n_failed = (adj & fires[None, :] & (drop | ~online[:, None])).sum()
+            received_any = (live & (self.mixing > 0)).any(axis=1)
+
+            def age_max(n_updates):
+                return jnp.where(live, n_updates[None, :], 0).max(axis=1)
+
+            # The mixing merge: one matmul per parameter leaf — dense
+            # einsum, or the explicit shard_map+ppermute ring schedule over
+            # the mesh.
+            if self.ring_mix:
+                from ..parallel.collectives import ring_mix_pytree
+
+                def mix_tree(params):
+                    return ring_mix_pytree(w_eff, params, self.mesh,
+                                           self._ring_axis)
+            else:
+                def mix_tree(params):
+                    return jax.tree.map(
+                        lambda p: (w_eff @ p.reshape(n, -1)).reshape(p.shape),
+                        params)
+
+        size = self._model_size(state.model.params)
         mode = self.handler.mode
         if mode == CreateModelMode.UPDATE_MERGE:
             keys = jax.random.split(self._round_key(base_key, r, _K_A2A_UPDATE), n)
@@ -251,7 +302,7 @@ class All2AllGossipSimulator(GossipSimulator):
         else:  # MERGE_UPDATE (the reference's supported path, handler.py:652-654)
             mixed = mix_tree(state.model.params)
             model = state.model
-        ages = jnp.where(live, model.n_updates[None, :], 0).max(axis=1)
+        ages = age_max(model.n_updates)
         new_age = jnp.maximum(model.n_updates, ages)
         params = select_nodes(received_any, mixed, model.params)
         model = ModelState(params, model.opt_state,

@@ -5,6 +5,7 @@ and the reference's StaticP2PNetwork (core.py:311-361) — need O(N^2).
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
@@ -123,4 +124,195 @@ class TestEngineOnSparse:
                               protocol=AntiEntropyProtocol.PUSH)
         st = sim.init_nodes(key)
         st, rep = sim.start(st, n_rounds=15)
+        assert rep.curves(local=False)["accuracy"][-1] > 0.8
+
+
+def _logreg_setup(n=24, d=8, seed=0, samples_per_node=12):
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import losses
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n * samples_per_node, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.25),
+                          n=n)
+    return disp, d
+
+
+class TestSparseMixing:
+    """O(E) mixing weights + the segment-sum All2All merge (round-3: the
+    Koloskova variant past the dense wall, VERDICT next #5)."""
+
+    def _topos(self, n=24, degree=6):
+        dense = Topology.random_regular(n, degree, seed=3)
+        return dense, SparseTopology.from_dense(dense)
+
+    def test_uniform_weights_match_dense(self):
+        from gossipy_tpu.core import SparseMixing, uniform_mixing
+        dense, sparse = self._topos()
+        wd = np.asarray(uniform_mixing(dense))
+        ws = uniform_mixing(sparse)
+        assert isinstance(ws, SparseMixing)
+        np.testing.assert_allclose(np.asarray(ws.self_w), np.diag(wd),
+                                   rtol=1e-6)
+        got = np.zeros_like(wd)
+        got[np.asarray(ws.rows), np.asarray(ws.senders)] = \
+            np.asarray(ws.edge_w)
+        np.fill_diagonal(got, np.diag(wd))
+        np.testing.assert_allclose(got, wd, rtol=1e-6)
+
+    def test_metropolis_weights_match_dense(self):
+        from gossipy_tpu.core import metropolis_hastings_mixing
+        dense, sparse = self._topos()
+        wd = np.asarray(metropolis_hastings_mixing(dense))
+        ws = metropolis_hastings_mixing(sparse)
+        got = np.zeros_like(wd)
+        got[np.asarray(ws.rows), np.asarray(ws.senders)] = \
+            np.asarray(ws.edge_w)
+        np.fill_diagonal(got, np.asarray(ws.self_w))
+        np.testing.assert_allclose(got, wd, rtol=1e-6, atol=1e-7)
+
+    def test_all2all_sparse_equals_dense(self, key):
+        """Same config, no faults: the segment-sum path must produce the
+        same simulation as the dense einsum (summation order differs ->
+        allclose, not equal)."""
+        import optax as _optax
+        from gossipy_tpu.core import CreateModelMode, uniform_mixing
+        from gossipy_tpu.handlers import WeightedSGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import All2AllGossipSimulator
+        from gossipy_tpu.utils import params_allclose
+
+        dense, sparse = self._topos()
+        disp, d = _logreg_setup(n=dense.num_nodes)
+        h = WeightedSGDHandler(model=LogisticRegression(d, 2),
+                               loss=losses.cross_entropy,
+                               optimizer=_optax.sgd(0.3), local_epochs=1,
+                               batch_size=8, n_classes=2, input_shape=(d,),
+                               create_model_mode=CreateModelMode.MERGE_UPDATE)
+        results = []
+        for topo in (dense, sparse):
+            sim = All2AllGossipSimulator(h, topo, disp.stacked(), delta=8,
+                                         mixing=uniform_mixing(topo))
+            st = sim.init_nodes(key)
+            st, rep = sim.start(st, n_rounds=4, key=jax.random.PRNGKey(5))
+            results.append((st, rep.curves(local=False)["accuracy"][-1]))
+        (s_dense, acc_d), (s_sparse, acc_s) = results
+        assert params_allclose(s_dense.model.params, s_sparse.model.params,
+                               atol=1e-4)
+        assert abs(acc_d - acc_s) < 1e-6
+
+    def test_all2all_sparse_with_faults_learns(self, key):
+        """Drop/churn on the sparse path: edge-wise Bernoulli gates keep the
+        mix a convex combination (row renormalization) and learning still
+        proceeds."""
+        import optax as _optax
+        from gossipy_tpu.core import CreateModelMode, uniform_mixing
+        from gossipy_tpu.handlers import WeightedSGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import All2AllGossipSimulator
+
+        sparse = SparseTopology.random_regular(24, 6, seed=9)
+        disp, d = _logreg_setup(n=24)
+        h = WeightedSGDHandler(model=LogisticRegression(d, 2),
+                               loss=losses.cross_entropy,
+                               optimizer=_optax.sgd(0.5), local_epochs=1,
+                               batch_size=8, n_classes=2, input_shape=(d,),
+                               create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = All2AllGossipSimulator(h, sparse, disp.stacked(), delta=8,
+                                     mixing=uniform_mixing(sparse),
+                                     drop_prob=0.2, online_prob=0.8)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=12, key=jax.random.PRNGKey(6))
+        acc = rep.curves(local=False)["accuracy"][-1]
+        assert np.isfinite(acc) and acc > 0.75
+
+    def test_sparse_mixing_scale_50k_construction(self):
+        """The O(E) objects at 50k nodes: mixing build is sub-second and
+        carries 2E edge weights, no [N, N] anywhere."""
+        import time
+        from gossipy_tpu.core import SparseMixing, uniform_mixing
+        n, deg = 50_000, 20
+        topo = SparseTopology.random_regular(n, deg, seed=1)
+        t0 = time.perf_counter()
+        mix = uniform_mixing(topo)
+        dt = time.perf_counter() - t0
+        assert isinstance(mix, SparseMixing)
+        assert mix.edge_w.shape == (n * deg,)
+        assert dt < 5.0
+
+
+class TestCacheNeighOnSparse:
+    def _sim(self, topo, n=16):
+        import optax as _optax
+        from gossipy_tpu.core import CreateModelMode
+        from gossipy_tpu.handlers import SGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import CacheNeighGossipSimulator
+
+        disp, d = _logreg_setup(n=n)
+        h = SGDHandler(model=LogisticRegression(d, 2),
+                       loss=losses.cross_entropy, optimizer=_optax.sgd(0.3),
+                       local_epochs=1, batch_size=8, n_classes=2,
+                       input_shape=(d,),
+                       create_model_mode=CreateModelMode.MERGE_UPDATE)
+        return CacheNeighGossipSimulator(h, topo, disp.stacked(), delta=8)
+
+    def test_neighbor_table_matches_dense(self):
+        """The padded [N, max_deg] slot layout is identical for a dense
+        Topology and its CSR view (both sorted neighbor order) — no [N, N]
+        slot table exists on either path. (Exact run equality between the
+        two is not expected: peer SAMPLING consumes differently-shaped RNG
+        draws per topology representation.)"""
+        dense = Topology.random_regular(16, 4, seed=2)
+        sparse = SparseTopology.from_dense(dense)
+        sd = self._sim(dense)
+        ss = self._sim(sparse)
+        np.testing.assert_array_equal(np.asarray(sd.nbr_table),
+                                      np.asarray(ss.nbr_table))
+        assert sd.nbr_table.shape == (16, 4)
+
+    def test_parking_slots_by_sender(self, key):
+        """_apply_receive parks a peer model in the sender's slot; a sender
+        that is not a neighbor parks nothing."""
+        from gossipy_tpu.simulation.engine import PeerModel
+
+        dense = Topology.random_regular(12, 4, seed=6)
+        sim = self._sim(SparseTopology.from_dense(dense), n=12)
+        st = sim.init_nodes(key)
+        n = 12
+        peer = PeerModel(st.model.params, st.model.n_updates)
+        # Every node claims sender = its own first neighbor.
+        senders = np.asarray(sim.nbr_table)[:, 0].copy()
+        st2 = sim._apply_receive(st, peer, jnp.asarray(senders),
+                                 jnp.ones(n, bool), None)
+        assert bool(st2.aux["cache_valid"][:, 0].all())
+        # A non-neighbor sender must not park anywhere.
+        non_nbr = []
+        tbl = np.asarray(sim.nbr_table)
+        for i in range(n):
+            cand = next(j for j in range(n)
+                        if j != i and j not in tbl[i])
+            non_nbr.append(cand)
+        st3 = sim._apply_receive(st, peer, jnp.asarray(non_nbr, np.int32),
+                                 jnp.ones(n, bool), None)
+        assert not bool(st3.aux["cache_valid"].any())
+
+    def test_learns_on_sparse(self, key):
+        import optax as _optax
+        from gossipy_tpu.core import CreateModelMode
+        from gossipy_tpu.handlers import SGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import CacheNeighGossipSimulator
+
+        sparse = SparseTopology.random_regular(32, 6, seed=5)
+        disp, d = _logreg_setup(n=32)
+        h = SGDHandler(model=LogisticRegression(d, 2),
+                       loss=losses.cross_entropy, optimizer=_optax.sgd(0.5),
+                       local_epochs=1, batch_size=8, n_classes=2,
+                       input_shape=(d,),
+                       create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = CacheNeighGossipSimulator(h, sparse, disp.stacked(), delta=8)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=12, key=jax.random.PRNGKey(7))
         assert rep.curves(local=False)["accuracy"][-1] > 0.8
