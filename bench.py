@@ -359,53 +359,34 @@ def bench_mfu(rounds: int = 50) -> None:
     })
 
 
-def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
-    """Scale row: gossip rounds/sec at ``n_nodes`` (default 50k).
+def _scale_harness(n_nodes: int, rounds: int, build_sim):
+    """Shared scaffolding for the scale rows: synthetic spambase-shaped
+    data (4 samples/node), capped evaluation, compile + timed double run.
 
-    Uses :class:`SparseTopology` (CSR neighbor lists, O(E) memory) — the
-    representation that breaks the dense [N, N] wall BOTH engines share at
-    round 1 (ours: core.Topology; reference: StaticP2PNetwork,
-    core.py:311-361 — a 50k-node dense adjacency is ~2.5 GB before the
-    simulation even starts, and the reference's Python round loop would
-    need hours per round at this node count, so there is no reference
-    number to compare against). Synthetic spambase-shaped data, 4 samples
-    per node; evaluation on the final round only (the metric is engine
-    throughput, not learning).
+    Evaluation memory scales as [eval-nodes x eval-samples]: an uncapped
+    20% eval split at 50k nodes is a [50k, 40k] score tensor (~16+ GB, OOM
+    on a single chip). The eval set is capped and a 1% node sample is
+    evaluated on the final round only — the metric is engine throughput,
+    not the learning curve.
+
+    ``build_sim(handler_kwargs, disp) -> (sim, build_seconds)`` constructs
+    the topology/mixing + simulator and reports its own build time.
+    Returns ``(rounds_per_sec, final_accuracy, build_seconds)``.
     """
     import jax
-    import optax
 
-    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
-        SparseTopology
     from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
-    from gossipy_tpu.handlers import SGDHandler, losses
-    from gossipy_tpu.models import LogisticRegression
-    from gossipy_tpu.simulation import GossipSimulator
 
     d = 57
     rng = np.random.default_rng(42)
     w = rng.normal(size=d)
     X = rng.normal(size=(4 * n_nodes, d)).astype(np.float32)
     y = (X @ w > 0).astype(np.int64)
-    # Evaluation memory scales as [eval-nodes x eval-samples]: an uncapped
-    # 20% eval split at 50k nodes is a [50k, 40k] score tensor (~16+ GB,
-    # OOM on a single chip). Cap the eval set and evaluate a 1% node sample
-    # — the metric here is engine throughput, not the learning curve.
     eval_cap = min(2048, int(0.2 * len(X)))  # a cap, not a floor: small
-    disp = DataDispatcher(                   # --scale runs keep a 20% split
+    disp = DataDispatcher(                   # runs keep a 20% split
         ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
         n=n_nodes, eval_on_user=False)
-    handler = SGDHandler(model=LogisticRegression(d, 2),
-                         loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
-                         local_epochs=1, batch_size=4, n_classes=2,
-                         input_shape=(d,),
-                         create_model_mode=CreateModelMode.MERGE_UPDATE)
-    t0 = time.perf_counter()
-    topo = SparseTopology.random_regular(n_nodes, DEGREE, seed=42)
-    build_s = time.perf_counter() - t0
-    sim = GossipSimulator(handler, topo, disp.stacked(), delta=ROUND_LEN,
-                          protocol=AntiEntropyProtocol.PUSH,
-                          sampling_eval=0.01, eval_every=rounds)
+    sim, build_s = build_sim(d, disp)
     key = jax.random.PRNGKey(42)
     state = sim.init_nodes(key)
     s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
@@ -415,22 +396,57 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
     jax.block_until_ready(s3.model.params)
     elapsed = time.perf_counter() - t0
     acc = report.curves(local=False)["accuracy"][-1]
+    return rounds / elapsed, float(acc), build_s
+
+
+def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
+    """Scale row: gossip rounds/sec at ``n_nodes`` (default 50k).
+
+    Uses :class:`SparseTopology` (CSR neighbor lists, O(E) memory) — the
+    representation that breaks the dense [N, N] wall BOTH engines share at
+    round 1 (ours: core.Topology; reference: StaticP2PNetwork,
+    core.py:311-361 — a 50k-node dense adjacency is ~2.5 GB before the
+    simulation even starts, and the reference's Python round loop would
+    need hours per round at this node count, so there is no reference
+    number to compare against).
+    """
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+        SparseTopology
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    def build_sim(d, disp):
+        handler = SGDHandler(model=LogisticRegression(d, 2),
+                             loss=losses.cross_entropy,
+                             optimizer=optax.sgd(0.1),
+                             local_epochs=1, batch_size=4, n_classes=2,
+                             input_shape=(d,),
+                             create_model_mode=CreateModelMode.MERGE_UPDATE)
+        t0 = time.perf_counter()
+        topo = SparseTopology.random_regular(n_nodes, DEGREE, seed=42)
+        build_s = time.perf_counter() - t0
+        sim = GossipSimulator(handler, topo, disp.stacked(), delta=ROUND_LEN,
+                              protocol=AntiEntropyProtocol.PUSH,
+                              sampling_eval=0.01, eval_every=rounds)
+        return sim, build_s
+
+    rate, acc, build_s = _scale_harness(n_nodes, rounds, build_sim)
     print(f"[scale] {n_nodes} nodes: topology {build_s:.2f}s, {rounds} "
-          f"rounds in {elapsed:.2f}s ({rounds / elapsed:.1f} r/s), "
-          f"final acc {acc:.3f}", file=sys.stderr)
+          f"rounds at {rate:.1f} r/s, final acc {acc:.3f}", file=sys.stderr)
     emit({
         "metric": f"sim_rounds_per_sec_{n_nodes}nodes",
-        "value": round(rounds / elapsed, 2),
+        "value": round(rate, 2),
         "unit": "rounds/s",
         "vs_baseline": None,
         "raw": {
             "n_nodes": n_nodes,
             "degree": DEGREE,
             "rounds": rounds,
-            "backend": jax.default_backend(),
-            "device_kind": jax.devices()[0].device_kind,
             "topology_build_seconds": round(build_s, 2),
-            "final_global_accuracy": round(float(acc), 4),
+            "final_global_accuracy": round(acc, 4),
             "note": "no reference baseline exists: a dense 50k-node "
                     "adjacency (~2.5 GB) plus a per-object Python round "
                     "loop is out of the reference's reach",
@@ -446,51 +462,35 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
     simul.py:720-852) are dense-only on top of a per-object Python loop, so
     no reference number exists at this node count.
     """
-    import jax
     import optax
 
     from gossipy_tpu.core import CreateModelMode, SparseTopology, \
         uniform_mixing
-    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
     from gossipy_tpu.handlers import WeightedSGDHandler, losses
     from gossipy_tpu.models import LogisticRegression
     from gossipy_tpu.simulation import All2AllGossipSimulator
 
-    d = 57
-    rng = np.random.default_rng(42)
-    w = rng.normal(size=d)
-    X = rng.normal(size=(4 * n_nodes, d)).astype(np.float32)
-    y = (X @ w > 0).astype(np.int64)
-    eval_cap = min(2048, int(0.2 * len(X)))  # see bench_scale
-    disp = DataDispatcher(
-        ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
-        n=n_nodes, eval_on_user=False)
-    handler = WeightedSGDHandler(
-        model=LogisticRegression(d, 2), loss=losses.cross_entropy,
-        optimizer=optax.sgd(0.1), local_epochs=1, batch_size=4, n_classes=2,
-        input_shape=(d,), create_model_mode=CreateModelMode.MERGE_UPDATE)
-    t0 = time.perf_counter()
-    topo = SparseTopology.random_regular(n_nodes, DEGREE, seed=42)
-    mixing = uniform_mixing(topo)
-    build_s = time.perf_counter() - t0
-    sim = All2AllGossipSimulator(handler, topo, disp.stacked(),
-                                 delta=ROUND_LEN, mixing=mixing,
-                                 sampling_eval=0.01, eval_every=rounds)
-    key = jax.random.PRNGKey(42)
-    state = sim.init_nodes(key)
-    s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
-    jax.block_until_ready(s2.model.params)
-    t0 = time.perf_counter()
-    s3, report = sim.start(state, n_rounds=rounds, key=key)
-    jax.block_until_ready(s3.model.params)
-    elapsed = time.perf_counter() - t0
-    acc = report.curves(local=False)["accuracy"][-1]
+    def build_sim(d, disp):
+        handler = WeightedSGDHandler(
+            model=LogisticRegression(d, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.1), local_epochs=1, batch_size=4,
+            n_classes=2, input_shape=(d,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        t0 = time.perf_counter()
+        topo = SparseTopology.random_regular(n_nodes, DEGREE, seed=42)
+        mixing = uniform_mixing(topo)
+        build_s = time.perf_counter() - t0
+        sim = All2AllGossipSimulator(handler, topo, disp.stacked(),
+                                     delta=ROUND_LEN, mixing=mixing,
+                                     sampling_eval=0.01, eval_every=rounds)
+        return sim, build_s
+
+    rate, acc, build_s = _scale_harness(n_nodes, rounds, build_sim)
     print(f"[scale-all2all] {n_nodes} nodes: build {build_s:.2f}s, {rounds} "
-          f"rounds in {elapsed:.2f}s ({rounds / elapsed:.1f} r/s), "
-          f"final acc {acc:.3f}", file=sys.stderr)
+          f"rounds at {rate:.1f} r/s, final acc {acc:.3f}", file=sys.stderr)
     emit({
         "metric": f"all2all_rounds_per_sec_{n_nodes}nodes",
-        "value": round(rounds / elapsed, 2),
+        "value": round(rate, 2),
         "unit": "rounds/s",
         "vs_baseline": None,
         "raw": {
@@ -498,7 +498,7 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
             "degree": DEGREE,
             "rounds": rounds,
             "topology_and_mixing_build_seconds": round(build_s, 2),
-            "final_global_accuracy": round(float(acc), 4),
+            "final_global_accuracy": round(acc, 4),
             "note": "sparse (segment-sum) mixing merge; the reference's "
                     "All2All simulator is dense-only Python",
         },

@@ -208,9 +208,12 @@ class SparseTopology:
     row — one [N] gather instead of an [N, N] categorical.
 
     This breaks the scale wall the reference shares (its
-    ``StaticP2PNetwork``, core.py:311-361, is dense-only). Features that
-    inherently need the dense matrix (mixing matrices / All2All einsum)
-    remain with :class:`Topology`.
+    ``StaticP2PNetwork``, core.py:311-361, is dense-only). Mixing weights
+    come along for the ride: :func:`uniform_mixing` /
+    :func:`metropolis_hastings_mixing` return O(E) :class:`SparseMixing`
+    edge weights for a SparseTopology, and the All2All simulator merges
+    them with a segment-sum — only the explicit ``ring_mix`` matmul
+    schedule still needs a dense :class:`Topology`.
     """
 
     def __init__(self, num_nodes: int, edges: np.ndarray):
@@ -403,7 +406,7 @@ def _csr_edge_arrays(topo: "SparseTopology"):
     return rows, topo.indices
 
 
-def uniform_mixing(topology) -> jnp.ndarray:
+def uniform_mixing(topology) -> "jnp.ndarray | SparseMixing":
     """Uniform mixing weights: row i weights node i and each of its deg(i)
     peers by 1/(deg(i)+1) — the matrix form of ``UniformMixing.get``
     (reference core.py:419-434), which returns the per-node weight vector
@@ -426,7 +429,7 @@ def uniform_mixing(topology) -> jnp.ndarray:
     return jnp.asarray(w, dtype=jnp.float32)
 
 
-def metropolis_hastings_mixing(topology) -> jnp.ndarray:
+def metropolis_hastings_mixing(topology) -> "jnp.ndarray | SparseMixing":
     """Metropolis-Hastings mixing weights (symmetric, doubly stochastic).
 
     W_ij = 1 / (1 + max(deg_i, deg_j)) for edges, W_ii = 1 - sum_j W_ij.
