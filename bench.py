@@ -248,7 +248,8 @@ PEAK_FLOPS = {
 }
 
 
-def bench_mfu(rounds: int = 50) -> None:
+def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
+              n_train: int | None = None, n_test: int | None = None) -> None:
     """Model-FLOPs-utilization for the CNN north-star config.
 
     Runs the CIFAR-10 100-node CNN round program (CIFAR-shaped synthetic
@@ -257,6 +258,9 @@ def bench_mfu(rounds: int = 50) -> None:
     FLOP/s by the chip's peak. Prints ONE JSON line. ``vs_baseline`` is
     reported against 1.0 "full chip" (the reference cannot run this
     workload on an accelerator at all, so there is no reference MFU).
+
+    ``n_nodes``/``n_train``/``n_test`` override the workload size (smoke
+    tests; the measured MFU is only meaningful at the default scale).
     """
     import jax
     import jax.numpy as jnp
@@ -274,8 +278,12 @@ def bench_mfu(rounds: int = 50) -> None:
     # round, bf16 emulated); shrink it and compute in fp32 — the run is
     # labeled degraded and MFU is null off-TPU anyway (unknown device kind),
     # so only the smoke value (finite ms/round) matters.
-    n_nodes = 8 if DEGRADED else N_NODES
-    n_train, n_test = (256, 64) if DEGRADED else (12800, 1280)
+    if n_nodes is None:
+        n_nodes = 8 if DEGRADED else N_NODES
+    if n_train is None:
+        n_train = 256 if DEGRADED else 12800
+    if n_test is None:
+        n_test = 64 if DEGRADED else 1280
     rounds = 1 if DEGRADED else rounds
     Xtr = rng.normal(size=(n_train, 32, 32, 3)).astype(np.float32)
     ytr = rng.integers(0, 10, n_train)
@@ -369,9 +377,10 @@ def _scale_harness(n_nodes: int, rounds: int, build_sim):
     evaluated on the final round only — the metric is engine throughput,
     not the learning curve.
 
-    ``build_sim(handler_kwargs, disp) -> (sim, build_seconds)`` constructs
-    the topology/mixing + simulator and reports its own build time.
-    Returns ``(rounds_per_sec, final_accuracy, build_seconds)``.
+    ``build_sim(feature_dim, disp) -> (sim, build_seconds)`` constructs
+    the handler + topology/mixing + simulator and reports its own
+    topology-build time. Returns
+    ``(rounds_per_sec, final_accuracy, build_seconds)``.
     """
     import jax
 
@@ -505,7 +514,7 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
     })
 
 
-def bench_fused_regime(rounds: int = 40) -> None:
+def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
     """Pallas ``fused_merge`` in its design regime: CNN-sized params, clique
     fan-in (every mailbox slot regularly occupied), MERGE_UPDATE deliver.
 
@@ -513,6 +522,7 @@ def bench_fused_regime(rounds: int = 40) -> None:
     config (254 vs 247 ms/round); this mode answers whether the kernel wins
     where the gather materialization actually dominates, or should be
     retired to documentation. Prints ONE JSON line with both timings.
+    ``n`` overrides the node count (smoke tests only).
     """
     import jax
     import jax.numpy as jnp
@@ -524,7 +534,6 @@ def bench_fused_regime(rounds: int = 40) -> None:
     from gossipy_tpu.models import CIFAR10Net
     from gossipy_tpu.simulation import GossipSimulator
 
-    n = 64
     rng = np.random.default_rng(0)
     Xtr = rng.normal(size=(n * 64, 32, 32, 3)).astype(np.float32)
     ytr = rng.integers(0, 10, n * 64)
@@ -535,7 +544,10 @@ def bench_fused_regime(rounds: int = 40) -> None:
         optimizer=optax.sgd(0.05), local_epochs=1, batch_size=32,
         n_classes=10, input_shape=(32, 32, 3),
         create_model_mode=CreateModelMode.MERGE_UPDATE,
-        compute_dtype=jnp.bfloat16)
+        # bf16 is the TPU measurement dtype; on CPU (smoke only — the fused
+        # run is skipped there anyway) bf16 is emulated and ~10x slower.
+        compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else None)
 
     def run(fused: bool) -> float:
         sim = GossipSimulator(handler, Topology.clique(n), disp.stacked(),

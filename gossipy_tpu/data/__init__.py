@@ -379,8 +379,11 @@ def load_classification_dataset(name: str = "spambase", normalize: bool = True,
     (iris/breast/digits/wine) load locally; the UCI names
     (spambase/sonar/ionosphere/abalone/banknote/reuters) are downloaded by
     the reference — in an egress-less environment we substitute a
-    deterministic synthetic dataset with the same shape and warn.
+    deterministic synthetic dataset with the same shape and warn. A ``name``
+    that is an existing file path loads as svmlight format (the reference's
+    else-branch, data/__init__.py:614-616).
     """
+    raw_name = name  # un-lowered: file paths are case-sensitive
     name = name.lower()
     if name == "iris":
         from sklearn.datasets import load_iris
@@ -396,6 +399,15 @@ def load_classification_dataset(name: str = "spambase", normalize: bool = True,
         X, y = load_wine(return_X_y=True)
     elif name in UCI_SHAPES:
         X, y = _load_uci_or_synthetic(name, allow_synthetic)
+    elif os.path.isfile(raw_name):
+        # After the known names, like the reference's else-branch
+        # (data/__init__.py:614-616): an existing file loads as svmlight
+        # format. Checked last so a stray local file named like a dataset
+        # cannot shadow a built-in loader.
+        from sklearn.datasets import load_svmlight_file
+        Xs, y = load_svmlight_file(raw_name)
+        X = np.asarray(Xs.todense())
+        y = _label_encode(np.asarray(y).tolist())
     else:
         raise ValueError(f"Unknown dataset: {name}")
 
@@ -418,6 +430,45 @@ def _fetch_to(url: str, path: str, timeout: float = 30.0) -> None:
         shutil.copyfileobj(r, f)
 
 
+def data_cache_dir() -> str:
+    """Persistent archive cache (override with ``GOSSIPY_TPU_DATA_DIR``).
+
+    The reference re-downloads into ``./data`` per script
+    (utils.py:98-149 + ``shutil.rmtree``); here every loader caches under
+    one user-level directory and reuses the archive on subsequent calls.
+    """
+    d = os.environ.get("GOSSIPY_TPU_DATA_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "gossipy_tpu_data")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _fetch_cached(url: str, filename: str, timeout: float = 30.0) -> str:
+    """Download ``url`` once into :func:`data_cache_dir`; reuse afterwards.
+
+    Partial downloads cannot poison the cache: the fetch lands in a
+    ``.part`` file and is renamed into place only on success.
+    """
+    import tempfile
+
+    path = os.path.join(data_cache_dir(), filename)
+    if os.path.isfile(path) and os.path.getsize(path) > 0:
+        return path
+    # Unique temp name per fetch: two concurrent processes must not
+    # interleave writes into one .part file (os.replace is atomic, so the
+    # last complete download wins).
+    fd, part = tempfile.mkstemp(dir=data_cache_dir(),
+                                suffix=".part", prefix=filename + ".")
+    os.close(fd)
+    try:
+        _fetch_to(url, part, timeout)
+        os.replace(part, path)
+    finally:
+        if os.path.exists(part):
+            os.unlink(part)
+    return path
+
+
 def _label_encode(values) -> np.ndarray:
     """Sorted-unique label encoding (sklearn LabelEncoder semantics)."""
     classes = {v: i for i, v in enumerate(sorted(set(values)))}
@@ -435,9 +486,8 @@ def _load_reuters():
     from sklearn.datasets import load_svmlight_file
 
     url = "http://download.joachims.org/svm_light/examples/example1.tar.gz"
+    arc = _fetch_cached(url, "example1.tar.gz")
     with tempfile.TemporaryDirectory() as tmp:
-        arc = os.path.join(tmp, "example1.tar.gz")
-        _fetch_to(url, arc)
         with tarfile.open(arc) as tf:
             tf.extractall(tmp, filter="data")  # refuse path traversal
         folder = os.path.join(tmp, "example1")
@@ -490,24 +540,22 @@ def _load_movielens(name: str):
     ratings: dict[int, list[tuple[int, float]]] = {}
     umap: dict[int, int] = {}
     imap: dict[int, int] = {}
-    with tempfile.TemporaryDirectory() as tmp:
-        arc = os.path.join(tmp, f"{name}.zip")
-        _fetch_to(url, arc)
-        with zipfile.ZipFile(arc) as zf:
-            member = next(m for m in zf.namelist()
-                          if m.endswith("/" + filename) or m == filename)
-            with zf.open(member) as f:
-                for line in f.read().decode().strip().split("\n"):
-                    if name == "ml-20m" and line.startswith("userId"):
-                        continue  # csv header
-                    u, i, r = line.strip().split(sep)[:3]
-                    u, i, r = int(u), int(i), float(r)
-                    if u not in umap:
-                        umap[u] = len(umap)
-                        ratings[umap[u]] = []
-                    if i not in imap:
-                        imap[i] = len(imap)
-                    ratings[umap[u]].append((imap[i], r))
+    arc = _fetch_cached(url, f"{name}.zip")
+    with zipfile.ZipFile(arc) as zf:
+        member = next(m for m in zf.namelist()
+                      if m.endswith("/" + filename) or m == filename)
+        with zf.open(member) as f:
+            for line in f.read().decode().strip().split("\n"):
+                if name == "ml-20m" and line.startswith("userId"):
+                    continue  # csv header
+                u, i, r = line.strip().split(sep)[:3]
+                u, i, r = int(u), int(i), float(r)
+                if u not in umap:
+                    umap[u] = len(umap)
+                    ratings[umap[u]] = []
+                if i not in imap:
+                    imap[i] = len(imap)
+                ratings[umap[u]].append((imap[i], r))
     return ratings, len(umap), len(imap)
 
 
@@ -565,24 +613,20 @@ def _download_cifar10():
     no torchvision needed. Returns NHWC float32 in [0, 1]."""
     import pickle
     import tarfile
-    import tempfile
-    import urllib.request
 
     url = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
-    with tempfile.TemporaryDirectory() as tmp:
-        arc = os.path.join(tmp, "cifar10.tar.gz")
-        _fetch_to(url, arc)
+    arc = _fetch_cached(url, "cifar-10-python.tar.gz")
 
-        def batch(tf, member):
-            d = pickle.load(tf.extractfile(member), encoding="bytes")
-            X = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-            return X.astype(np.float32) / 255.0, np.array(d[b"labels"],
-                                                          dtype=np.int64)
-        with tarfile.open(arc) as tf:
-            members = {m.name: m for m in tf.getmembers()}
-            tr = [batch(tf, members[f"cifar-10-batches-py/data_batch_{i}"])
-                  for i in range(1, 6)]
-            Xte, yte = batch(tf, members["cifar-10-batches-py/test_batch"])
+    def batch(tf, member):
+        d = pickle.load(tf.extractfile(member), encoding="bytes")
+        X = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return X.astype(np.float32) / 255.0, np.array(d[b"labels"],
+                                                      dtype=np.int64)
+    with tarfile.open(arc) as tf:
+        members = {m.name: m for m in tf.getmembers()}
+        tr = [batch(tf, members[f"cifar-10-batches-py/data_batch_{i}"])
+              for i in range(1, 6)]
+        Xte, yte = batch(tf, members["cifar-10-batches-py/test_batch"])
     Xtr = np.concatenate([x for x, _ in tr])
     ytr = np.concatenate([y for _, y in tr])
     return (Xtr, ytr), (Xte, yte)
@@ -612,13 +656,12 @@ def _download_fashion_mnist():
     """FashionMNIST from the canonical idx-format files (no torchvision).
     Returns NHWC float32 in [0, 1]."""
     import gzip
-    import urllib.request
 
     base = "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
 
     def fetch(fname):
-        return gzip.decompress(
-            urllib.request.urlopen(base + fname, timeout=30).read())
+        with open(_fetch_cached(base + fname, f"fashion-{fname}"), "rb") as f:
+            return gzip.decompress(f.read())
 
     def images(buf):
         n = int.from_bytes(buf[4:8], "big")
@@ -674,9 +717,15 @@ def _download_femnist(n_writers: int):
             cursor += ni
         return X[:cursor], y[:cursor], assignment
 
+    def load_pt(path):
+        # weights_only=True: the archive comes from a third-party GitHub
+        # repo — never let torch.load unpickle arbitrary objects from it.
+        # Tensor-tuple payloads load fine under weights_only; if the
+        # archive ever needs richer types, fail rather than deserialize.
+        return torch.load(path, map_location="cpu", weights_only=True)
+
+    arc = _fetch_cached(url, "femnist.tar.gz")
     with tempfile.TemporaryDirectory() as tmp:
-        arc = os.path.join(tmp, "femnist.tar.gz")
-        _fetch_to(url, arc)
         with tarfile.open(arc) as tf:
             tf.extractall(tmp, filter="data")  # refuse path traversal
         paths = [os.path.join(root, f)
@@ -684,8 +733,8 @@ def _download_femnist(n_writers: int):
                  if f.endswith((".pt", ".pth"))]
         tr_path = next(p for p in paths if "train" in os.path.basename(p))
         te_path = next(p for p in paths if "test" in os.path.basename(p))
-        Xtr, ytr, ids_tr = torch.load(tr_path, map_location="cpu")
-        Xte, yte, ids_te = torch.load(te_path, map_location="cpu")
+        Xtr, ytr, ids_tr = load_pt(tr_path)
+        Xte, yte, ids_te = load_pt(te_path)
     return (to_numpy(Xtr, ytr, ids_tr, n_writers),
             to_numpy(Xte, yte, ids_te, n_writers))
 

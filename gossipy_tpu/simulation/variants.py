@@ -192,6 +192,11 @@ class All2AllGossipSimulator(GossipSimulator):
         if self.sparse_mix:
             assert mixing.num_nodes == self.n_nodes, \
                 "mixing/topology node-count mismatch"
+            # The segment ops run with indices_are_sorted=True; a hand-built
+            # mixing with unsorted rows would produce silently wrong sums.
+            rows = np.asarray(mixing.rows)
+            assert rows.size == 0 or (np.diff(rows) >= 0).all(), \
+                "SparseMixing.rows must be non-decreasing (CSR row order)"
             self.mixing = mixing
         else:
             # Fail at construction, not at the first jitted round's

@@ -35,6 +35,49 @@ def test_scale_reports_backend_and_build_time(bench, capfd):
     assert row["raw"]["topology_build_seconds"] >= 0
 
 
+def test_mfu_json_contract(bench, capfd, monkeypatch):
+    """--mfu must work first-try when the tunnel returns: assert the JSON
+    shape on a tiny CPU run — MFU is null off-TPU (unknown device kind,
+    loud warning) but ms/round must be finite and the line fully labeled."""
+    monkeypatch.setattr(bench, "DEGRADED", True)  # fp32 + 1 round
+    bench.bench_mfu(rounds=1, n_nodes=4, n_train=64, n_test=32)
+    row = last_json(capfd)
+    assert row["metric"] == "mfu_cifar10_100nodes_cnn"
+    assert row["unit"] == "fraction_of_peak"
+    raw = row["raw"]
+    assert raw["degraded"] is True and raw["backend"] in ("cpu", "tpu")
+    assert np.isfinite(raw["ms_per_round"]) and raw["ms_per_round"] > 0
+    if raw["device_kind"] not in bench.PEAK_FLOPS:
+        assert row["value"] is None
+        assert raw["peak_tflops_per_sec"] is None
+    else:
+        assert row["value"] is not None and row["value"] > 0
+
+
+def test_fused_regime_json_contract(bench, capfd):
+    """--fused-regime off-TPU: plain timing is measured, the fused leg is
+    skipped with an explicit reason in raw.error."""
+    import jax
+    bench.bench_fused_regime(rounds=1, n=4)
+    row = last_json(capfd)
+    assert row["metric"] == "fused_merge_speedup_cnn_clique"
+    raw = row["raw"]
+    assert np.isfinite(raw["plain_ms_per_round"])
+    if jax.default_backend() != "tpu":
+        assert row["value"] is None
+        assert raw["fused_ms_per_round"] is None
+        assert "skipped off-TPU" in raw["error"]
+
+
+def test_scale_all2all_json_contract(bench, capfd):
+    bench.bench_scale_all2all(64, rounds=2)
+    row = last_json(capfd)
+    assert row["metric"] == "all2all_rounds_per_sec_64nodes"
+    assert row["unit"] == "rounds/s" and row["value"] > 0
+    assert np.isfinite(row["raw"]["final_global_accuracy"])
+    assert row["raw"]["topology_and_mixing_build_seconds"] >= 0
+
+
 def test_eval_memory_warning_fires_at_scale_trap():
     """The engine warns at construction for the [nodes x samples] eval
     blow-up the scale bench hit (16 GB at 50k nodes x 40k samples)."""
