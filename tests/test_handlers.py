@@ -336,8 +336,9 @@ class TestMFHandler:
         mask = jnp.ones(30)
         st = h.init(key)
         r0 = float(h.evaluate(st, (items, ratings, mask))["rmse"])
+        upd = jax.jit(h.update)  # compile once; 30 eager traces cost ~8 s
         for i in range(30):
-            st = h.update(st, (items, ratings, mask), key)
+            st = upd(st, (items, ratings, mask), key)
         r1 = float(h.evaluate(st, (items, ratings, mask))["rmse"])
         assert r1 < r0
         assert r1 < 1.0

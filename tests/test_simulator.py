@@ -30,8 +30,8 @@ def make_dataset(n=400, d=10, seed=0, signed=False):
 
 
 def make_sim(n_nodes=16, protocol=AntiEntropyProtocol.PUSH, signed=True,
-             handler=None, delta=20, topo=None, **sim_kwargs):
-    X, y = make_dataset(signed=signed)
+             handler=None, delta=20, topo=None, n_samples=400, **sim_kwargs):
+    X, y = make_dataset(n=n_samples, signed=signed)
     dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
     disp = DataDispatcher(dh, n=n_nodes)
     if topo is None:
@@ -107,13 +107,18 @@ class TestMinimumSlice:
         whether the round program runs compiled or op-by-op (guards the
         scan/fori_loop rewrite against trace-vs-eager divergence)."""
         run_key = jax.random.fold_in(key, 3)
-        sim = make_sim(n_nodes=8)
+        # Small world, 2 rounds: under disable_jit every lax.scan/vmap runs
+        # as a Python loop, so eager cost ~ total samples x rounds (~15 s at
+        # the suite's default 400-sample dataset). Round 2 already covers
+        # delivery of round-1 sends, where trace-vs-eager divergence would
+        # hide.
+        sim = make_sim(n_nodes=8, n_samples=96)
         st = sim.init_nodes(key)
-        _, rep_jit = sim.start(st, n_rounds=3, key=run_key)
-        sim2 = make_sim(n_nodes=8)
+        _, rep_jit = sim.start(st, n_rounds=2, key=run_key)
+        sim2 = make_sim(n_nodes=8, n_samples=96)
         st2 = sim2.init_nodes(key)
         with jax.disable_jit():
-            _, rep_eager = sim2.start(st2, n_rounds=3, key=run_key)
+            _, rep_eager = sim2.start(st2, n_rounds=2, key=run_key)
         np.testing.assert_allclose(rep_jit.curves(local=False)["accuracy"],
                                    rep_eager.curves(local=False)["accuracy"],
                                    rtol=1e-5)
