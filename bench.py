@@ -508,8 +508,9 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
             "rounds": rounds,
             "topology_and_mixing_build_seconds": round(build_s, 2),
             "final_global_accuracy": round(acc, 4),
-            "note": "sparse (segment-sum) mixing merge; the reference's "
-                    "All2All simulator is dense-only Python",
+            "note": "sparse O(E) mixing merge (auto form: padded "
+                    "gather+einsum on TPU, sorted segment-sum on CPU); the "
+                    "reference's All2All simulator is dense-only Python",
         },
     })
 

@@ -316,3 +316,67 @@ class TestCacheNeighOnSparse:
         st = sim.init_nodes(key)
         st, rep = sim.start(st, n_rounds=12, key=jax.random.PRNGKey(7))
         assert rep.curves(local=False)["accuracy"][-1] > 0.8
+
+
+class TestSparseMixFormulations:
+    """The two O(E) All2All merge forms (padded gather+einsum vs edge-list
+    segment-sum) must agree with each other and with the dense einsum."""
+
+    def _build(self, topo, key, form="auto"):
+        import optax as _optax
+        from gossipy_tpu.core import CreateModelMode, uniform_mixing
+        from gossipy_tpu.handlers import WeightedSGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import All2AllGossipSimulator
+
+        disp, d = _logreg_setup(n=topo.num_nodes)
+        h = WeightedSGDHandler(model=LogisticRegression(d, 2),
+                               loss=losses.cross_entropy,
+                               optimizer=_optax.sgd(0.3), local_epochs=1,
+                               batch_size=8, n_classes=2, input_shape=(d,),
+                               create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = All2AllGossipSimulator(h, topo, disp.stacked(), delta=8,
+                                     mixing=uniform_mixing(topo),
+                                     sparse_mix_form=form)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=3, key=jax.random.PRNGKey(8))
+        return sim, st, rep.curves(local=False)["accuracy"][-1]
+
+    def test_padded_and_segment_forms_agree(self, key):
+        from gossipy_tpu.utils import params_allclose
+        topo = SparseTopology.random_regular(24, 6, seed=1)
+        sim_pad, st_pad, acc_pad = self._build(topo, key, form="padded")
+        assert sim_pad._sparse_padded
+        sim_seg, st_seg, acc_seg = self._build(topo, key, form="segment")
+        assert not sim_seg._sparse_padded
+        assert params_allclose(st_pad.model.params, st_seg.model.params,
+                               atol=1e-5)
+        # Accuracy quantizes to 1/n_samples; different summation orders can
+        # flip a borderline sample — params_allclose above is the real
+        # equivalence check, this is a sanity band.
+        assert abs(acc_pad - acc_seg) < 0.05
+
+    def test_auto_form_by_backend(self, key):
+        import jax as _jax
+        topo = SparseTopology.random_regular(12, 4, seed=2)
+        sim, _, acc = self._build(topo, key, form="auto")
+        # auto = padded only on TPU (measured: segment wins on CPU).
+        assert sim._sparse_padded == (_jax.default_backend() == "tpu")
+        assert np.isfinite(acc)
+
+    def test_hub_graph_requires_segment_form(self, key):
+        # Star graph: one hub of degree n-1 vs mean ~2 — padding to
+        # max_deg would be O(N * max_deg); auto must pick segment-sum and
+        # an explicit 'padded' request must refuse.
+        from gossipy_tpu.core import uniform_mixing
+        from gossipy_tpu.simulation import All2AllGossipSimulator
+        n = 24
+        edges = np.stack([np.zeros(n - 1, np.int64),
+                          np.arange(1, n, dtype=np.int64)], axis=1)
+        topo = SparseTopology(n, edges)
+        sim, st, acc = self._build(topo, key)
+        assert not sim._sparse_padded
+        assert np.isfinite(acc)
+        disp, d = _logreg_setup(n=n)
+        with pytest.raises(ValueError, match="heavy-tailed"):
+            self._build(topo, key, form="padded")
