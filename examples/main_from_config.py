@@ -36,7 +36,13 @@ def main():
         parser.error("a config file is required (or --dump-default)")
     cfg = ExperimentConfig.from_json(args.config)
     state, report = run_experiment(cfg)
-    finish(report, args, local=False)
+    # Recsys experiments evaluate user-wise only (local RMSE, like the
+    # reference's main_hegedus_2020 plots); fall back to the local curves
+    # when no global evaluation exists.
+    rep0 = report[0] if isinstance(report, (list, tuple)) else report
+    use_local = (not rep0.get_evaluation(False)
+                 and bool(rep0.get_evaluation(True)))
+    finish(report, args, local=use_local)
 
 
 if __name__ == "__main__":

@@ -212,7 +212,9 @@ class SparseTopology:
     come along for the ride: :func:`uniform_mixing` /
     :func:`metropolis_hastings_mixing` return O(E) :class:`SparseMixing`
     edge weights for a SparseTopology, and the All2All simulator merges
-    them with a segment-sum — only the explicit ``ring_mix`` matmul
+    them without any [N, N] tensor (padded [N, max_deg] gather+einsum on
+    TPU / near-regular graphs, edge-list segment-sum otherwise — see
+    ``All2AllGossipSimulator``); only the explicit ``ring_mix`` matmul
     schedule still needs a dense :class:`Topology`.
     """
 

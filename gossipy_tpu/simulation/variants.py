@@ -196,6 +196,11 @@ class All2AllGossipSimulator(GossipSimulator):
         super().__init__(*args, **kwargs)
         assert self.protocol == AntiEntropyProtocol.PUSH, \
             "All2AllNode only supports PUSH protocol."  # node.py:856-858
+        if sparse_mix_form not in ("auto", "padded", "segment"):
+            # Validated for BOTH mixing kinds: a typo must not silently
+            # no-op on the dense path.
+            raise ValueError(f"unknown sparse_mix_form {sparse_mix_form!r}; "
+                             "options: auto, padded, segment")
         self.sparse_mix = isinstance(mixing, SparseMixing)
         if self.sparse_mix:
             if mixing.num_nodes != self.n_nodes:  # must survive python -O
@@ -218,10 +223,6 @@ class All2AllGossipSimulator(GossipSimulator):
             # gather materialization dominates). Heavy-tailed degree
             # distributions (BA hubs) always take the segment path: padding
             # to a hub's degree would be O(N * max_deg).
-            if sparse_mix_form not in ("auto", "padded", "segment"):
-                raise ValueError(f"unknown sparse_mix_form "
-                                 f"{sparse_mix_form!r}; options: auto, "
-                                 "padded, segment")
             degrees = np.bincount(rows, minlength=self.n_nodes)
             max_deg = int(degrees.max()) if rows.size else 0
             mean_deg = float(degrees.mean()) if rows.size else 0.0

@@ -90,6 +90,22 @@ def test_baseline_smoke():
         assert np.isfinite(summary[side]["accuracy"])
 
 
+def test_config_runner_recsys_reports_local_rmse(tmp_path):
+    """A recsys config (user-wise evaluation only) must still report rounds
+    and a final metric through main_from_config (regression: the runner
+    printed rounds=0 reading the empty global curves)."""
+    import dataclasses
+
+    from gossipy_tpu.config import ExperimentConfig
+    cfg = ExperimentConfig.from_json(
+        os.path.join(REPO, "examples", "configs", "hegedus_2020.json"))
+    p = tmp_path / "recsys_tiny.json"
+    dataclasses.replace(cfg, n_rounds=2).to_json(str(p))
+    summary = run_example("main_from_config.py", [str(p)])
+    assert summary["rounds"] == 2
+    assert np.isfinite(summary["final"]["rmse"])
+
+
 def test_example_repetitions_smoke():
     """--repetitions runs the vmapped batch and reports mean finals."""
     summary = run_example("main_ormandi_2013.py",

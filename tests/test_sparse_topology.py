@@ -368,8 +368,6 @@ class TestSparseMixFormulations:
         # Star graph: one hub of degree n-1 vs mean ~2 — padding to
         # max_deg would be O(N * max_deg); auto must pick segment-sum and
         # an explicit 'padded' request must refuse.
-        from gossipy_tpu.core import uniform_mixing
-        from gossipy_tpu.simulation import All2AllGossipSimulator
         n = 24
         edges = np.stack([np.zeros(n - 1, np.int64),
                           np.arange(1, n, dtype=np.int64)], axis=1)
@@ -377,6 +375,5 @@ class TestSparseMixFormulations:
         sim, st, acc = self._build(topo, key)
         assert not sim._sparse_padded
         assert np.isfinite(acc)
-        disp, d = _logreg_setup(n=n)
         with pytest.raises(ValueError, match="heavy-tailed"):
             self._build(topo, key, form="padded")
