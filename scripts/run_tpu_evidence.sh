@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The deferred TPU measurement list (round-2/3 VERDICT "deliver the TPU
+# evidence"): run every bench mode on the real chip and append the raw JSON
+# lines to BENCH_TPU_EVIDENCE.jsonl for BASELINE.md. Each mode is
+# timeout-guarded; bench.py itself degrades to a labeled CPU fallback if the
+# tunnel dies mid-list, so a partial run still records labeled rows.
+#
+# Usage: bash scripts/run_tpu_evidence.sh   (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+OUT=BENCH_TPU_EVIDENCE.jsonl
+echo "# $(date -Is) tpu evidence run" >> "$OUT"
+for args in "" "--mfu 50" "--scale 50000" "--scale 100000" \
+            "--scale-all2all 50000" "--fused-regime"; do
+    echo "=== bench.py $args" >&2
+    # shellcheck disable=SC2086
+    timeout 3000 python bench.py $args 2> >(tail -5 >&2) | tail -1 | \
+        tee -a "$OUT"
+done
+echo "done; rows appended to $OUT" >&2
