@@ -34,6 +34,31 @@ DCN_AXIS = "dcn"
 MODEL_AXIS = "model"
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     **kwargs) -> None:
+    """Join (or form) a multi-host JAX cluster before any jax computation.
+
+    The multi-host analogue of the reference's absent comm backend
+    (SURVEY §2.12 — its "network" is a Python loop): after this call
+    ``jax.devices()`` is GLOBAL across all processes, :func:`make_mesh` /
+    :func:`make_mesh_2d` build cluster-wide meshes, and
+    :func:`shard_state` / :func:`shard_data` place the node axis across
+    hosts — every process runs the SAME program and XLA routes the
+    collectives (ICI within a host, DCN/Gloo across).
+
+    On Cloud TPU pods all three arguments auto-detect (call with no args);
+    elsewhere pass the coordinator's ``host:port``, the process count, and
+    this process's rank. Thin wrapper over ``jax.distributed.initialize``
+    so user code never imports jax internals; extra kwargs pass through
+    (e.g. ``local_device_ids``).
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = NODE_AXIS) -> Mesh:
     """A 1-D device mesh over the first ``n_devices`` devices."""
     devs = jax.devices()

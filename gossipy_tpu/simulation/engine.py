@@ -949,18 +949,30 @@ class GossipSimulator(SimulationEventSender):
         return restore_checkpoint(path, template, key)
 
     def _make_run(self, n_rounds: int, live: bool):
-        """The ``n_rounds``-round scan as a pure (state, key) -> (state,
-        stats) function — the unit :meth:`start` jits and :meth:`lower_start`
-        AOT-lowers."""
-        def run(state, key):
-            last = state.round + n_rounds - 1
+        """The ``n_rounds``-round scan as a pure (state, key, data) ->
+        (state, stats) function — the unit :meth:`start` jits and
+        :meth:`lower_start` AOT-lowers.
 
-            def body(st, _):
-                st, stats = self._round(st, key, last)
-                if live:
-                    self._emit_live(st, stats)
-                return st, stats
-            return jax.lax.scan(body, state, None, length=n_rounds)
+        ``data`` is an explicit ARGUMENT, not a closure capture: on a
+        multi-controller cluster (``parallel.init_distributed``) the stacked
+        data spans processes, and jit forbids closing over arrays with
+        non-addressable shards. Inside the trace ``self.data`` is rebound to
+        the traced values so every helper reads the argument.
+        """
+        def run(state, key, data):
+            saved = self.data
+            self.data = data
+            try:
+                last = state.round + n_rounds - 1
+
+                def body(st, _):
+                    st, stats = self._round(st, key, last)
+                    if live:
+                        self._emit_live(st, stats)
+                    return st, stats
+                return jax.lax.scan(body, state, None, length=n_rounds)
+            finally:
+                self.data = saved
         return run
 
     def lower_start(self, state: SimState, n_rounds: int = 100,
@@ -975,7 +987,8 @@ class GossipSimulator(SimulationEventSender):
         """
         if key is None:
             key = jax.random.PRNGKey(42)
-        return jax.jit(self._make_run(n_rounds, live=False)).lower(state, key)
+        return jax.jit(self._make_run(n_rounds, live=False)).lower(
+            state, key, self.data)
 
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None,
@@ -1006,10 +1019,11 @@ class GossipSimulator(SimulationEventSender):
 
         if profile_dir is not None:
             with jax.profiler.trace(profile_dir):
-                state, stats = self._jit_cache[cache_k](state, key)
+                state, stats = self._jit_cache[cache_k](state, key,
+                                                        self.data)
                 jax.block_until_ready(state.model.params)
         else:
-            state, stats = self._jit_cache[cache_k](state, key)
+            state, stats = self._jit_cache[cache_k](state, key, self.data)
         self.replay_events(first_round, stats, self._metric_keys(),
                            include_live=live_fallback)
         return state, self._build_report(stats)
@@ -1040,7 +1054,9 @@ class GossipSimulator(SimulationEventSender):
         Returns the stacked final states (leading seed axis) and one
         :class:`SimulationReport` per seed. Event receivers are not
         supported here (which repetition's events would they see?) — use
-        ``start`` per seed when you need the event stream.
+        ``start`` per seed when you need the event stream. Single-controller
+        only (the seed batch closes over the data; on a multi-host cluster
+        run :meth:`start` per seed instead).
         """
         assert not self._receivers_list(), \
             "run_repetitions does not support event receivers; use start()"
