@@ -69,6 +69,17 @@ def test_fused_regime_json_contract(bench, capfd):
         assert "skipped off-TPU" in raw["error"]
 
 
+@pytest.mark.slow
+def test_to_acc_mode_reports_target_round(bench, capsys):
+    """--to-acc runs the chunked accuracy search and reports the hit round
+    (100-node program: slow lane)."""
+    X, y = bench.make_data()
+    bench.bench_to_accuracy(X, y, target=0.5)
+    out = capsys.readouterr().out
+    assert "[to-acc]" in out
+    assert "reached at round" in out, out
+
+
 def test_scale_all2all_json_contract(bench, capfd):
     bench.bench_scale_all2all(64, rounds=2)
     row = last_json(capfd)
