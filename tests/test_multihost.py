@@ -66,8 +66,29 @@ sim = GossipSimulator(h, Topology.random_regular(n, 4, seed=0),
 state = shard_state(sim.init_nodes(jax.random.PRNGKey(0)), mesh)
 state, report = sim.start(state, n_rounds=10, key=jax.random.PRNGKey(1))
 acc = report.curves(local=False)["accuracy"]
+
+# DP x TP leg: a (nodes, model) mesh whose axes both span the process
+# boundary - parameter leaves shard their largest non-node dim over
+# "model", contraction psums cross processes.
+from gossipy_tpu.models import MLP
+from gossipy_tpu.parallel import make_mesh_tp
+mesh_tp = make_mesh_tp(4, 2)
+h_tp = SGDHandler(model=MLP(d, 2, hidden_dims=(16,)),
+                  loss=losses.cross_entropy, optimizer=optax.sgd(0.5),
+                  local_epochs=1, batch_size=8, n_classes=2,
+                  input_shape=(d,),
+                  create_model_mode=CreateModelMode.MERGE_UPDATE)
+sim_tp = GossipSimulator(h_tp, Topology.random_regular(n, 4, seed=0),
+                         shard_data(disp.stacked(), mesh_tp), delta=8,
+                         protocol=AntiEntropyProtocol.PUSH)
+st_tp = shard_state(sim_tp.init_nodes(jax.random.PRNGKey(2)), mesh_tp)
+st_tp, rep_tp = sim_tp.start(st_tp, n_rounds=2, key=jax.random.PRNGKey(3))
+acc_tp = rep_tp.curves(local=False)["accuracy"]
+
 print("RESULT " + json.dumps({"proc": int(sys.argv[3]),
-                              "acc": [round(float(a), 6) for a in acc]}),
+                              "acc": [round(float(a), 6) for a in acc],
+                              "acc_tp": [round(float(a), 6)
+                                         for a in acc_tp]}),
       flush=True)
 """
 
@@ -139,3 +160,9 @@ def test_two_process_cluster_runs_one_gossip_program():
     # streams) to float32 noise — cross-process (Gloo) reductions may
     # differ from local ones by an ulp.
     np.testing.assert_allclose(acc_single, acc0, atol=1e-5)
+    # DP x TP leg: both controllers agree and match the single-process run.
+    tp0 = _result(outs[0][0])["acc_tp"]
+    tp1 = _result(outs[1][0])["acc_tp"]
+    tp_single = _result(outs[2][0])["acc_tp"]
+    assert tp0 == tp1 and np.isfinite(tp0).all()
+    np.testing.assert_allclose(tp_single, tp0, atol=1e-5)
