@@ -27,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..simulation.engine import Mailbox, SimState
+from ..simulation.engine import SimState
 
 NODE_AXIS = "nodes"
 DCN_AXIS = "dcn"

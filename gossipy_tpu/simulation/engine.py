@@ -41,8 +41,6 @@ Fidelity notes (documented divergences, SURVEY.md §7c):
 
 from __future__ import annotations
 
-import math
-from functools import partial
 from typing import Any, NamedTuple, Optional
 
 import jax

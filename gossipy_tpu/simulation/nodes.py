@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import CreateModelMode, MessageType
+from ..core import CreateModelMode
 from ..handlers.base import ModelState, PeerModel
 from .engine import GossipSimulator, SimState, select_nodes, _K_PEER
 from .report import SimulationReport

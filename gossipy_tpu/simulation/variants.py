@@ -15,7 +15,7 @@ import numpy as np
 
 from ..core import AntiEntropyProtocol, CreateModelMode, MessageType
 from ..flow_control import TokenAccount
-from ..handlers.base import ModelState, PeerModel
+from ..handlers.base import ModelState
 from .engine import GossipSimulator, PROTO_TO_MSG, SimState, select_nodes
 from .nodes import PartitioningGossipSimulator
 
