@@ -62,6 +62,8 @@ def emit(payload: dict) -> None:
     raw.setdefault("backend", jax.default_backend())
     raw.setdefault("device_kind", jax.devices()[0].device_kind)
     raw["degraded"] = DEGRADED
+    if DEGRADED and os.environ.get("GOSSIPY_TPU_DEGRADE_REASON"):
+        raw["degrade_reason"] = os.environ["GOSSIPY_TPU_DEGRADE_REASON"]
     print(json.dumps(payload))
 
 
@@ -535,6 +537,13 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
     from gossipy_tpu.models import CIFAR10Net
     from gossipy_tpu.simulation import GossipSimulator
 
+    # The degraded CPU fallback cannot afford the full clique-64 CNN
+    # measurement (fp32 CNN rounds on this 1-core host are ~0.5 s each and
+    # the mode compiles + times TWO simulators); shrink it — the run is
+    # labeled degraded and the fused leg is skipped off-TPU anyway, so only
+    # a finite plain ms/round matters.
+    if DEGRADED:
+        rounds, n = min(rounds, 4), min(n, 16)
     rng = np.random.default_rng(0)
     Xtr = rng.normal(size=(n * 64, 32, 32, 3)).astype(np.float32)
     ytr = rng.integers(0, 10, n * 64)
@@ -620,20 +629,146 @@ def _backend_alive(timeout: float = 150.0) -> bool:
     return True
 
 
-def _degrade_to_cpu() -> None:
+def _deadline_override(default: float) -> float:
+    """The watchdog deadline: ``GOSSIPY_TPU_BENCH_DEADLINE`` if set and
+    parsable, else ``default``. The ONE place the override is interpreted —
+    both the watchdog and ``--print-deadline`` (which the evidence script's
+    outer timeout is derived from) go through here, so they cannot drift.
+    """
+    raw = os.environ.get("GOSSIPY_TPU_BENCH_DEADLINE", "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"[bench] ignoring malformed GOSSIPY_TPU_BENCH_DEADLINE="
+              f"{raw!r}; using {default:.0f}", file=sys.stderr)
+        return default
+
+
+def _run_with_watchdog(default_deadline: float = 1500.0) -> None:
+    """Run the accelerator attempt in a deadline-guarded child.
+
+    A live probe does not guarantee a live run: the tunneled runtime has
+    been observed to initialize fine in the probe subprocess and then wedge
+    the very next client mid-initialization or mid-execution (2026-07-31:
+    main thread asleep at ~1% CPU, axon relay thread parked on epoll,
+    indefinitely). The child's stdout is streamed through line by line
+    (unbuffered child, so the JSON row crosses the pipe the moment it is
+    printed); if the child does not finish inside the deadline it is killed
+    and the bench degrades to the labeled CPU fallback — the driver gets a
+    parseable row in every tunnel state, including mid-run wedges.
+
+    Two deliberate asymmetries: a child that already emitted its JSON row
+    and THEN wedged or crashed (e.g. in jax runtime teardown) is treated as
+    success — the accelerator measurement is out and must not be superseded
+    by a degraded CPU row; and a degrade triggered by a nonzero child exit
+    is labeled with that rc in the row (``raw.degrade_reason``) so a
+    deterministic bench/engine crash stays distinguishable from a tunnel
+    outage (the child's traceback also passes through on stderr).
+    The default deadline is mode-aware (``default_deadline``): the driver's
+    north-star run gets 1500 s (measured healthy time ≈ 3-4 min including a
+    cold compile), while big ``--scale N`` rows grow with N — the repo's own
+    records put 500k nodes at 0.10 r/s, i.e. ~2000 s of legitimate runtime
+    for the two 100-round passes, which a flat deadline would kill and
+    mislabel as a wedge. Override: ``GOSSIPY_TPU_BENCH_DEADLINE`` (seconds).
+    ``scripts/run_tpu_evidence.sh`` sizes its outer per-mode timeout as
+    probe + this deadline + CPU-fallback headroom so a mid-run wedge still
+    ends inside the budget with a labeled row.
+    """
+    import subprocess
+    import threading
+    deadline = _deadline_override(default_deadline)
+    import signal
+    env = dict(os.environ, PYTHONUNBUFFERED="1")
+    # Own session: if THIS process is killed externally (e.g. the evidence
+    # script's outer timeout), the finally below still reaps the — possibly
+    # wedged, tunnel-holding — child by process group instead of orphaning
+    # it into every subsequent mode.
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), *sys.argv[1:],
+         "--_accel-inner"], env=env, stdout=subprocess.PIPE, text=True,
+        start_new_session=True)
+    emitted = []
+
+    def pump():
+        for line in proc.stdout:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+            if line.startswith("{"):
+                emitted.append(line)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    rc = None
+    start = time.monotonic()
+    emit_seen_at = None
+    grace_after_emit = 60.0
+    try:
+        while True:
+            try:
+                # Poll granularity must not exceed the deadline itself, or
+                # sub-5s deadlines (the wedge test) silently become ~5s.
+                rc = proc.wait(timeout=min(5.0, deadline))
+                break
+            except subprocess.TimeoutExpired:
+                now = time.monotonic()
+                if emitted and emit_seen_at is None:
+                    emit_seen_at = now
+                # Once the one JSON row is out, don't idle away the rest of
+                # the deadline on a wedged teardown — a short grace, then
+                # reap and keep the measurement.
+                if (emit_seen_at is not None
+                        and now - emit_seen_at > grace_after_emit):
+                    break
+                if now - start > deadline:
+                    break
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+        t.join(timeout=10)
+    if rc is None:  # wedged: killed above after grace/deadline expiry
+        if emitted:
+            print("[bench] accelerator run emitted its row but wedged "
+                  "before exiting — keeping the measurement", file=sys.stderr)
+            sys.exit(0)
+        print(f"[bench] accelerator run wedged: no result after "
+              f"{deadline:.0f}s (probe had succeeded) — killed it, "
+              "degrading", file=sys.stderr)
+        _degrade_to_cpu("wedged_after_probe")  # does not return
+    if rc != 0:
+        if emitted:
+            print(f"[bench] accelerator run emitted its row but exited "
+                  f"rc={rc} (teardown failure) — keeping the measurement",
+                  file=sys.stderr)
+            sys.exit(0)
+        print(f"[bench] accelerator run failed (rc={rc}) — degrading",
+              file=sys.stderr)
+        _degrade_to_cpu(f"accel_run_rc_{rc}")  # does not return
+    sys.exit(0)
+
+
+def _degrade_to_cpu(reason: str = "backend_unreachable") -> None:
     """Re-exec this bench in a cleaned CPU-only environment.
 
     The child strips the TPU-plugin sitecustomize from PYTHONPATH (so
     ``import jax`` cannot hang on the dead tunnel) and runs the same mode
     with ``--_degraded``, which stamps ``"backend": "cpu",
-    "degraded": true`` into the JSON line — an outage round records a
-    labeled data point instead of rc=1.
+    "degraded": true`` plus ``degrade_reason`` into the JSON line — an
+    outage round records a labeled data point instead of rc=1, and a
+    crash-triggered degrade stays distinguishable from a tunnel outage.
     """
     import subprocess
     import _virtual_mesh
     here = os.path.dirname(os.path.abspath(__file__))
     env = _virtual_mesh.virtual_mesh_env(1, extra_path=here)
-    print("[bench] degrading to a labeled CPU fallback run",
+    env["GOSSIPY_TPU_DEGRADE_REASON"] = reason
+    print(f"[bench] degrading to a labeled CPU fallback run ({reason})",
           file=sys.stderr)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), *sys.argv[1:],
@@ -668,6 +803,9 @@ def main():
     if "--_degraded" in sys.argv:
         DEGRADED = True
         sys.argv.remove("--_degraded")
+    inner = "--_accel-inner" in sys.argv
+    if inner:
+        sys.argv.remove("--_accel-inner")
 
     # Parse argv first: usage errors must not pay the backend probe.
     mode, mode_arg = "north-star", None
@@ -690,8 +828,25 @@ def main():
                      "(0, 1]>, e.g. --to-acc 0.95")
         mode = "to-acc"
 
-    if not DEGRADED and not _backend_alive():
-        _degrade_to_cpu()  # does not return
+    if mode in ("scale", "scale-all2all"):
+        # Two 100-round passes over N nodes: scale the budget with N
+        # (500k nodes measured at 0.10 r/s -> ~2000s of healthy work).
+        deadline = 1500.0 + 0.025 * mode_arg
+    elif mode == "fused":
+        deadline = 2400.0  # two full CNN-clique compiles + 2x2 passes
+    else:
+        deadline = 1500.0
+    if "--print-deadline" in sys.argv:
+        # Budget query for scripts/run_tpu_evidence.sh: the mode-aware
+        # watchdog deadline lives in ONE place (here); the script derives
+        # its outer timeout from this instead of re-encoding the formula.
+        # Must not touch jax: answers even while the tunnel is wedged.
+        print(int(_deadline_override(deadline)))
+        return
+    if not DEGRADED and not inner:
+        if not _backend_alive():
+            _degrade_to_cpu()  # does not return
+        _run_with_watchdog(deadline)  # does not return
     from gossipy_tpu import enable_compilation_cache
     enable_compilation_cache()
     if mode == "mfu":

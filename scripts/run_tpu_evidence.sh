@@ -1,20 +1,35 @@
 #!/usr/bin/env bash
 # The deferred TPU measurement list (round-2/3 VERDICT "deliver the TPU
 # evidence"): run every bench mode on the real chip and append the raw JSON
-# lines to BENCH_TPU_EVIDENCE.jsonl for BASELINE.md. Each mode is
-# timeout-guarded; bench.py itself degrades to a labeled CPU fallback if the
-# tunnel dies mid-list, so a partial run still records labeled rows.
+# lines to BENCH_TPU_EVIDENCE.jsonl for BASELINE.md.
+#
+# Each mode's outer timeout is sized as probe (150s) + the watchdog deadline
+# bench.py computes for that mode + CPU-fallback headroom, so even a mid-run
+# tunnel wedge ends inside the budget with a labeled degraded row (bench.py
+# kills the wedged accelerator child itself and re-runs on CPU).
 #
 # Usage: bash scripts/run_tpu_evidence.sh   (from the repo root)
 set -u
 cd "$(dirname "$0")/.."
 OUT=BENCH_TPU_EVIDENCE.jsonl
 echo "# $(date -Is) tpu evidence run" >> "$OUT"
-for args in "" "--mfu 50" "--scale 50000" "--scale 100000" \
-            "--scale-all2all 50000" "--fused-regime"; do
-    echo "=== bench.py $args" >&2
-    # shellcheck disable=SC2086
-    timeout 3000 python bench.py $args 2> >(tail -5 >&2) | tail -1 | \
+# Single source of truth for the budget: bench.py owns the mode-aware
+# watchdog deadline (main(), incl. any GOSSIPY_TPU_BENCH_DEADLINE override);
+# the script queries it with --print-deadline (jax-free, answers even while
+# the tunnel is wedged) and derives the outer timeout as probe (150s) +
+# deadline + CPU-fallback headroom (1200s), so the two can never drift.
+run_mode() {  # run_mode [bench args...]
+    local d t
+    d=$(python bench.py --print-deadline "$@") || d=4000
+    t=$((d + 1350))
+    echo "=== $(date -Is) bench.py $* (deadline ${d}s, timeout ${t}s)" >&2
+    timeout "$t" python bench.py "$@" 2> >(tail -5 >&2) | tail -1 | \
         tee -a "$OUT"
-done
+}
+run_mode                           # north-star
+run_mode --mfu 50
+run_mode --scale 50000
+run_mode --scale 100000            # CPU fallback alone is ~12 min
+run_mode --scale-all2all 50000
+run_mode --fused-regime            # two full CNN-clique compiles
 echo "done; rows appended to $OUT" >&2

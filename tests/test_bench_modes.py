@@ -132,3 +132,33 @@ def test_eval_memory_warning_fires_at_scale_trap():
     with w.catch_warnings():
         w.simplefilter("error", UserWarning)
         sim._warn_if_eval_memory_large()
+
+
+@pytest.mark.slow
+def test_watchdog_degrades_on_wedged_accel_run():
+    """A mid-run wedge — probe succeeds, then the accelerator run never
+    finishes (observed 2026-07-31 on the tunneled runtime) — must still end
+    in a labeled degraded CPU row, not rc!=0. Forced here by a 1-second
+    deadline: the watchdog kills the inner run and re-execs the CPU
+    fallback."""
+    import json as j
+    import os
+    import subprocess
+    import sys
+
+    from _virtual_mesh import virtual_mesh_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = virtual_mesh_env(1, extra_path=repo)
+    env["GOSSIPY_TPU_BENCH_DEADLINE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--scale", "64"], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "wedged" in proc.stderr
+    row = j.loads([l for l in proc.stdout.strip().splitlines()
+                   if l.startswith("{")][-1])
+    assert row["raw"]["degraded"] is True
+    assert row["raw"]["backend"] == "cpu"
+    assert row["raw"]["degrade_reason"] == "wedged_after_probe"
+    assert row["value"] > 0
