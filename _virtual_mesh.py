@@ -42,6 +42,33 @@ def provisioned_device_count(xla_flags: str) -> int | None:
     return count
 
 
+def probe_backend_alive(timeout: float = 150.0) -> tuple[bool, str]:
+    """Probe in a disposable child that the default jax backend initializes.
+
+    A wedged TPU tunnel hangs backend init indefinitely; every driver-facing
+    entry point (``bench.py``, ``__graft_entry__``) must detect that in a
+    killable child instead of hanging in-process. Returns ``(ok, detail)``
+    where ``detail`` is the failure description (timeout note or the
+    child's trailing stderr) — the ONE shared probe, so timeout policy and
+    error surfacing cannot diverge between entry points.
+    """
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, (f"jax backend init still hung after {timeout:.0f}s "
+                       "in a probe subprocess")
+    if proc.returncode != 0:
+        return False, (f"jax backend failed to initialize in the probe "
+                       f"subprocess (rc={proc.returncode}); child stderr:\n"
+                       + proc.stderr[-2000:])
+    return True, ""
+
+
 def _is_tpu_plugin_entry(path: str) -> bool:
     """True for PYTHONPATH entries that belong to the TPU-plugin sitecustomize.
 

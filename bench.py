@@ -602,31 +602,17 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
     })
 
 
-def _backend_alive(timeout: float = 150.0) -> bool:
-    """Probe in a disposable child that the jax backend initializes.
-
-    A wedged TPU tunnel hangs ``import jax`` indefinitely; benching must
-    never hang the driver (same pattern as
-    ``__graft_entry__.dryrun_multichip``). Returns False on hang or child
-    failure (surfacing the child's stderr) so the caller can degrade to a
-    labeled CPU run instead of exiting 1.
-    """
-    import subprocess
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        print("[bench] accelerator backend unreachable: jax backend init "
-              f"still hung after {timeout:.0f}s in a probe subprocess",
+def _backend_alive() -> bool:
+    """Shared disposable-child probe (``_virtual_mesh.probe_backend_alive``):
+    a wedged TPU tunnel hangs backend init indefinitely, and benching must
+    never hang the driver. Returns False on hang or child failure so the
+    caller can degrade to a labeled CPU run instead of exiting 1."""
+    import _virtual_mesh
+    ok, detail = _virtual_mesh.probe_backend_alive()
+    if not ok:
+        print(f"[bench] accelerator backend unreachable: {detail}",
               file=sys.stderr)
-        return False
-    if proc.returncode != 0:
-        print("[bench] jax backend failed to initialize in the probe "
-              f"subprocess (rc={proc.returncode}); child stderr:\n"
-              + proc.stderr[-2000:], file=sys.stderr)
-        return False
-    return True
+    return ok
 
 
 def _deadline_override(default: float) -> float:

@@ -1,5 +1,7 @@
 """Pallas TPU kernels for the simulation hot paths."""
 
+from .attention import flash_attention, flash_hop_update
 from .merge import gather_merge_flat, gather_merge_pytree
 
-__all__ = ["gather_merge_flat", "gather_merge_pytree"]
+__all__ = ["flash_attention", "flash_hop_update", "gather_merge_flat",
+           "gather_merge_pytree"]

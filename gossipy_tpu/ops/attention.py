@@ -1,0 +1,273 @@
+"""Flash-attention pallas kernel for the ring-attention hop update.
+
+:func:`gossipy_tpu.parallel.collectives.ring_attention` streams key/value
+chunks around the ICI ring, maintaining per-query softmax statistics
+``(running max m, normalizer l, weighted-value accumulator acc)``. Its hop
+body composed from jnp primitives is two MXU matmuls (``q @ k_c.T`` and
+``p @ v_c``) with the ``[sl, sl]`` score/probability block materialized
+between them — XLA does not fuse across matmul boundaries, so for long
+per-device chunks that block round-trips HBM every hop.
+
+This kernel fuses one whole hop update: each ``block_q``-row program keeps
+its score block in VMEM from QK^T through the streaming-softmax rescale to
+the PV product and never writes it out. Same blockwise-softmax math as the
+public flash-attention/ring-attention formulation; layout follows
+pallas_guide.md (full-array trailing block dims; ``[rows, 1]`` carry
+vectors so the last block dim equals the array dim; ``broadcasted_iota``
+for position ids; f32 accumulation regardless of input dtype).
+
+Differentiation: ``pallas_call`` has no automatic reverse-mode, so the hop
+update carries a ``jax.custom_vjp`` whose backward re-derives the vjp from
+an identical jnp formulation of the same math (flash-style recompute — the
+score block is rebuilt from the saved inputs on the backward pass only).
+Gradient parity with the jnp path is tested in interpreter mode.
+
+Off-TPU the kernel runs in pallas interpreter mode (the CPU test mesh), and
+installs without pallas entirely via the jnp reference path — mirroring
+``ops/merge.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas is TPU/GPU-oriented; import guarded so CPU-only installs work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+# Finite stand-in for -inf: exp() stays nan-free (matches collectives.py).
+_NEG = -1e30
+# Default query rows per program. 128 rows x 128-lane tiles feed the MXU
+# full systolic-array slices; chunks shorter than this run as one block.
+BLOCK_Q = 128
+
+
+def hop_update_reference(q, k_c, v_c, m, l, acc, q_off, k_off, scale,
+                         causal: bool):
+    """The jnp hop update (identical math to collectives.ring_attention's
+    inline body): returns the rescaled ``(m, l, acc)`` after absorbing one
+    key/value chunk. Differentiable; the kernel's custom-vjp backward and
+    the off-pallas install path both use it."""
+    qf = q.astype(jnp.float32)
+    s = (qf @ k_c.T.astype(jnp.float32)) * scale  # [sl_q, sl_k]
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[0])
+        k_pos = k_off + jnp.arange(k_c.shape[0])
+        s = jnp.where(k_pos[None, :] > q_pos[:, None], _NEG, s)
+    m_new = jnp.maximum(m, s.max(axis=1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    acc = acc * alpha[:, None] + p @ v_c.astype(jnp.float32)
+    l = l * alpha + p.sum(axis=1)
+    return m_new, l, acc
+
+
+def _hop_kernel(scale, causal, block_q,
+                offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, a_ref,
+                om_ref, ol_ref, oa_ref):
+    i = pl.program_id(0)
+    q = q_ref[:].astype(jnp.float32)                       # [bq, D]
+    k = k_ref[:].astype(jnp.float32)                       # [sl_k, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+    if causal:
+        sl_k = k.shape[0]
+        q_pos = (offs_ref[0] + i * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, sl_k), 0))
+        k_pos = (offs_ref[1]
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, sl_k), 1))
+        s = jnp.where(k_pos > q_pos, _NEG, s)
+    m_in = m_ref[:][:, 0]                                   # [bq]
+    l_in = l_ref[:][:, 0]
+    m_new = jnp.maximum(m_in, s.max(axis=1))
+    alpha = jnp.exp(m_in - m_new)
+    p = jnp.exp(s - m_new[:, None])                         # stays in VMEM
+    oa_ref[:] = a_ref[:] * alpha[:, None] + p @ v_ref[:].astype(jnp.float32)
+    om_ref[:] = m_new[:, None]
+    ol_ref[:] = (l_in * alpha + p.sum(axis=1))[:, None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "causal", "interpret",
+                                    "block_q"))
+def _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale, causal,
+                       interpret, block_q):
+    sl_q, dim = q.shape
+    dv = v_c.shape[1]
+    bq = min(block_q, sl_q)
+    pad = (-sl_q) % bq
+    if pad:  # pad query rows; padded rows are sliced off below
+        q = jnp.pad(q, ((0, pad), (0, 0)))
+        m = jnp.pad(m, (0, pad), constant_values=_NEG)
+        l = jnp.pad(l, (0, pad))
+        acc = jnp.pad(acc, ((0, pad), (0, 0)))
+    slp = sl_q + pad
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # (q_off, k_off) int32[2]
+        grid=(slp // bq,),
+        in_specs=[
+            pl.BlockSpec((bq, dim), lambda i, o: (i, 0)),          # q
+            pl.BlockSpec(k_c.shape, lambda i, o: (0, 0)),          # k chunk
+            pl.BlockSpec(v_c.shape, lambda i, o: (0, 0)),          # v chunk
+            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),            # m
+            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),            # l
+            pl.BlockSpec((bq, dv), lambda i, o: (i, 0)),           # acc
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),
+            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),
+            pl.BlockSpec((bq, dv), lambda i, o: (i, 0)),
+        ],
+    )
+    # Under shard_map's varying-axes checking the out avals must declare
+    # which mesh axes they vary over: the union of the inputs' (outside
+    # shard_map the attribute is absent/empty and plain structs suffice).
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma
+                                  for x in (q, k_c, v_c, m, l, acc)))
+    except (AttributeError, TypeError):
+        vma = None
+
+    def sds(shape):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    om, ol, oa = pl.pallas_call(
+        functools.partial(_hop_kernel, scale, causal, bq),
+        grid_spec=grid_spec,
+        out_shape=[sds((slp, 1)), sds((slp, 1)), sds((slp, dv))],
+        interpret=interpret,
+    )(offs.astype(jnp.int32), q, k_c, v_c,
+      m.astype(jnp.float32)[:, None], l.astype(jnp.float32)[:, None],
+      acc.astype(jnp.float32))
+    return om[:sl_q, 0], ol[:sl_q, 0], oa[:sl_q]
+
+
+def _hop_bwd_math(scale, causal, res, g):
+    """Hand-derived vjp of the hop update (flash-style: the score block is
+    recomputed from the saved inputs, never stored). A nested ``jax.vjp``
+    of the jnp formulation would compute the same thing but does not trace
+    through eager ``shard_map``, and jitting interpreter-mode pallas under
+    grad explodes compile time — so the math is written out.
+
+    With s = scale·qk^T (masked to ``_NEG``), M = max(m_in, rowmax(s)),
+    A = exp(m_in − M), P = exp(s − M):
+        acc_out = A·acc_in + P v,   l_out = A·l_in + rowsum(P),  m_out = M.
+    """
+    q, k_c, v_c, m_in, l_in, acc_in, offs = res
+    gm, gl, gacc = [x.astype(jnp.float32) for x in g]
+    qf = q.astype(jnp.float32)
+    kf = k_c.astype(jnp.float32)
+    vf = v_c.astype(jnp.float32)
+
+    s = (qf @ kf.T) * scale
+    if causal:
+        q_pos = offs[0] + jnp.arange(q.shape[0])
+        k_pos = offs[1] + jnp.arange(k_c.shape[0])
+        masked = k_pos[None, :] > q_pos[:, None]
+        s = jnp.where(masked, _NEG, s)
+    smax = s.max(axis=1)
+    M = jnp.maximum(m_in, smax)
+    A = jnp.exp(m_in - M)
+    P = jnp.exp(s - M[:, None])
+
+    dacc_in = gacc * A[:, None]
+    dA = (gacc * acc_in).sum(axis=1) + gl * l_in
+    dP = gacc @ vf.T + gl[:, None]
+    dv = P.T @ gacc
+    ds = dP * P                      # ∂P/∂s = P elementwise
+    dM = gm - dA * A - ds.sum(axis=1)
+    # Route the max: to m_in where it won, else to s's argmax entries
+    # (ties split evenly, matching reduce_max's autodiff convention).
+    sel = m_in >= smax
+    dm_in = dA * A + jnp.where(sel, dM, 0.0)
+    eq = (s == smax[:, None]).astype(jnp.float32)
+    onehot = eq / jnp.maximum(eq.sum(axis=1, keepdims=True), 1.0)
+    ds = ds + jnp.where(sel, 0.0, dM)[:, None] * onehot
+    if causal:
+        ds = jnp.where(masked, 0.0, ds)
+    dq = (ds * scale) @ kf
+    dk = (ds * scale).T @ qf
+    dl_in = gl * A
+    d_offs = np.zeros(offs.shape, jax.dtypes.float0)
+    return (dq.astype(q.dtype), dk.astype(k_c.dtype), dv.astype(v_c.dtype),
+            dm_in, dl_in, dacc_in, d_offs)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_hop_update(scale: float, causal: bool, interpret: bool,
+                     block_q: int):
+    """Build the custom-vjp'd hop update for static (scale, causal, mode).
+
+    Forward runs the pallas kernel; backward is :func:`_hop_bwd_math`.
+    """
+    @jax.custom_vjp
+    def f(q, k_c, v_c, m, l, acc, offs):
+        return _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale,
+                                  causal, interpret, block_q)
+
+    def fwd(q, k_c, v_c, m, l, acc, offs):
+        return f(q, k_c, v_c, m, l, acc, offs), (q, k_c, v_c, m, l, acc,
+                                                 offs)
+
+    def bwd(res, g):
+        return _hop_bwd_math(scale, causal, res, g)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_hop_update(q, k_c, v_c, m, l, acc, q_off, k_off, scale,
+                     causal: bool = False,
+                     interpret: Optional[bool] = None,
+                     block_q: int = BLOCK_Q):
+    """One ring-attention hop as a fused pallas kernel.
+
+    ``q`` [sl_q, D] resident query block; ``k_c``/``v_c`` [sl_k, D]/[sl_k,
+    Dv] the chunk in flight; ``m``/``l``/[sl_q] and ``acc`` [sl_q, Dv] the
+    f32 streaming-softmax carry; ``q_off``/``k_off`` the chunks' global
+    row offsets (traced scalars — causal masking is by global position).
+    Returns the updated ``(m, l, acc)``. ``interpret=None`` auto-selects
+    interpreter mode off-TPU; without pallas installed, falls back to the
+    jnp formulation.
+    """
+    if not _HAS_PALLAS:
+        return hop_update_reference(q, k_c, v_c, m, l, acc, q_off, k_off,
+                                    scale, causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    fn = _make_hop_update(float(scale), bool(causal), bool(interpret),
+                          int(block_q))
+    return fn(q, k_c, v_c, m, l, acc, offs)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    interpret: Optional[bool] = None,
+                    block_q: int = BLOCK_Q):
+    """Single-device flash attention: softmax(q k^T / sqrt(D)) v with the
+    score matrix blocked through VMEM (one hop over the full sequence).
+
+    [S, D] inputs, one attention head; ``jax.vmap`` over heads/batch. The
+    sequence-parallel form is ``collectives.ring_attention(flash=True)``,
+    which runs this update once per ring hop.
+    """
+    s_len, dim = q.shape
+    scale = 1.0 / np.sqrt(dim)
+    m0 = jnp.full((s_len,), _NEG, jnp.float32)
+    l0 = jnp.zeros((s_len,), jnp.float32)
+    acc0 = jnp.zeros((s_len, v.shape[1]), jnp.float32)
+    m, l, acc = flash_hop_update(q, k, v, m0, l0, acc0, 0, 0, scale,
+                                 causal=causal, interpret=interpret,
+                                 block_q=block_q)
+    return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q.dtype)

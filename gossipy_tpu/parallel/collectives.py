@@ -160,7 +160,8 @@ def ring_mixed_matmul(w: jax.Array, x: jax.Array, mesh: Mesh,
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   axis_name=None, causal: bool = False) -> jax.Array:
+                   axis_name=None, causal: bool = False,
+                   flash: bool | None = None) -> jax.Array:
     """Sequence-parallel attention over a ppermute ring (blockwise softmax).
 
     ``q``/``k``/``v`` are ``[S, D]`` with the SEQUENCE axis sharded over the
@@ -178,9 +179,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     parallelism (the public "ring attention" schedule). ``causal=True``
     masks by GLOBAL position (device-block offsets included). Heads/batch:
     ``jax.vmap`` this over leading axes.
+
+    ``flash`` selects the hop implementation: the fused pallas kernel
+    (:mod:`gossipy_tpu.ops.attention` — the per-hop score block stays in
+    VMEM instead of round-tripping HBM between the two matmuls) or the
+    inline jnp body. Default: kernel on TPU, jnp elsewhere. Both are
+    differentiable (the kernel carries a recompute-based custom vjp) and
+    tested equal.
     """
     axis_name = _node_axis_entry(mesh, axis_name)
     d = _axis_size(mesh, axis_name)
+    if flash is None:
+        flash = jax.default_backend() == "tpu"
     s_len, dim = q.shape
     assert k.shape == (s_len, dim), \
         f"k {k.shape} must match q {(s_len, dim)}"
@@ -194,27 +204,26 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P(axis_name, None),) * 3,
-             out_specs=P(axis_name, None))
+             # The pallas hop kernel's interpreter mode does not thread
+             # varying-axes types onto in-kernel constants, so the vma
+             # check only runs on the jnp path.
+             out_specs=P(axis_name, None), check_vma=not flash)
     def body(q_l, k_l, v_l):
         me = jax.lax.axis_index(axis_name)
-        q_pos = me * sl + jnp.arange(sl)
-        qf = q_l.astype(jnp.float32)
 
         def hop(s_idx, carry, kv):
             m, l, acc = carry
             src = (me + s_idx) % d
             k_c = kv[:, :dim]
             v_c = kv[:, dim:]
-            s = (qf @ k_c.T.astype(jnp.float32)) * scale  # [sl, sl]
-            if causal:
-                k_pos = src * sl + jnp.arange(sl)
-                s = jnp.where(k_pos[None, :] > q_pos[:, None], NEG, s)
-            m_new = jnp.maximum(m, s.max(axis=1))
-            alpha = jnp.exp(m - m_new)            # rescale old statistics
-            p = jnp.exp(s - m_new[:, None])       # [sl, sl]
-            acc = acc * alpha[:, None] + p @ v_c.astype(jnp.float32)
-            l = l * alpha + p.sum(axis=1)
-            return m_new, l, acc
+            if flash:
+                from ..ops.attention import flash_hop_update
+                return flash_hop_update(q_l, k_c, v_c, m, l, acc,
+                                        me * sl, src * sl, scale,
+                                        causal=causal)
+            from ..ops.attention import hop_update_reference
+            return hop_update_reference(q_l, k_c, v_c, m, l, acc,
+                                        me * sl, src * sl, scale, causal)
 
         kv0 = jnp.concatenate([k_l, v_l], axis=1)
         m0 = jnp.full((sl,), NEG, jnp.float32)

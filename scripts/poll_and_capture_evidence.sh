@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Poll the tunneled TPU backend and capture the deferred bench evidence the
+# moment it comes back. Retry-aware: if the tunnel is up just long enough to
+# pass the probe but every bench row still degrades to the CPU fallback
+# (half-wedged relay), the attempt does NOT count — keep polling until at
+# least one genuine accelerator row lands or the probe budget runs out.
+#
+# Usage: bash scripts/poll_and_capture_evidence.sh [max_probes] [sleep_s]
+set -u
+cd "$(dirname "$0")/.."
+MAX=${1:-40}
+SLEEP=${2:-300}
+OUT=BENCH_TPU_EVIDENCE.jsonl
+for i in $(seq 1 "$MAX"); do
+    date -Is
+    if timeout 240 python -c \
+        "import jax; assert jax.devices()[0].platform != 'cpu'" \
+        2>/dev/null; then
+        echo "probe $i: tunnel alive; running the evidence list"
+        lines_before=$( [ -f "$OUT" ] && wc -l < "$OUT" || echo 0 )
+        bash scripts/run_tpu_evidence.sh
+        # Only rows appended by THIS attempt count — stale genuine rows
+        # from an earlier capture must not mask an all-degraded run.
+        if [ -f "$OUT" ] && tail -n +"$((lines_before + 1))" "$OUT" | \
+           grep '"degraded": false' | grep -qv '"backend": "cpu"'; then
+            echo "genuine accelerator rows captured; done"
+            exit 0
+        fi
+        echo "probe passed but every row degraded (half-wedged tunnel);" \
+             "continuing to poll"
+    else
+        echo "probe $i failed; sleeping $SLEEP"
+    fi
+    sleep "$SLEEP"
+done
+echo "gave up after $MAX probes"
+exit 1

@@ -14,7 +14,7 @@ def test_entry_pins_cpu_when_probe_wedges(monkeypatch):
 
     calls = {}
 
-    def fake_run(cmd, timeout=None, capture_output=False):
+    def fake_run(cmd, timeout=None, **kwargs):
         calls["timeout"] = timeout
         raise subprocess.TimeoutExpired(cmd=cmd, timeout=timeout)
 
