@@ -131,3 +131,13 @@ def test_ring_attention_flash_equals_jnp_path():
     for gf, gj in zip(loss(True), loss(False)):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gj),
                                    atol=1e-4)
+
+    # Heads axis via vmap (the documented multi-head pattern) over the
+    # kernel path.
+    H = 3
+    qh, kh, vh = (jax.random.normal(key, (3, H, S, D)))
+    ah = jax.vmap(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True,
+                                                 flash=True))(qh, kh, vh)
+    bh = jax.vmap(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True,
+                                                 flash=False))(qh, kh, vh)
+    np.testing.assert_allclose(np.asarray(ah), np.asarray(bh), atol=1e-5)
