@@ -418,3 +418,32 @@ class TestMixedPrecision:
         assert all(l.dtype == jnp.float32 for l in leaves)
         acc = h.evaluate(st, (X, y, mask))["accuracy"]
         assert float(acc) > 0.9, float(acc)
+
+
+class TestRemat:
+    def test_remat_is_numerically_identical(self):
+        """remat=True recomputes the forward on backward — results must be
+        bit-compatible with the stored-activation path (same ops, same
+        order), and the jitted update must compile."""
+        import numpy as np
+        key = jax.random.PRNGKey(7)
+        X, y, mask = make_binary_data()
+        y = y.astype(jnp.int32)
+
+        def run(remat):
+            h = SGDHandler(model=MLP(8, 2, hidden_dims=(16,)),
+                           loss=losses.cross_entropy,
+                           optimizer=optax.sgd(0.2), local_epochs=2,
+                           batch_size=16, n_classes=2, input_shape=(8,),
+                           remat=remat)
+            st = h.init(key)
+            upd = jax.jit(h.update)
+            for i in range(3):
+                st = upd(st, (X, y, mask), jax.random.fold_in(key, i))
+            return st
+
+        a, b = run(False), run(True)
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       atol=1e-6)
