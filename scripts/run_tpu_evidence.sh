@@ -32,4 +32,12 @@ run_mode --scale 50000
 run_mode --scale 100000            # CPU fallback alone is ~12 min
 run_mode --scale-all2all 50000
 run_mode --fused-regime            # two full CNN-clique compiles
+# Phase attribution for the MFU attack (VERDICT #2) — grab it while the
+# tunnel is up; rows are self-labeled with backend/device_kind.
+for pargs in "" "--cnn"; do
+    echo "=== $(date -Is) profile_round.py $pargs" >&2
+    # shellcheck disable=SC2086
+    timeout 2400 python scripts/profile_round.py $pargs \
+        2> >(tail -3 >&2) | tail -1 | tee -a "$OUT"
+done
 echo "done; rows appended to $OUT" >&2
