@@ -13,7 +13,10 @@ SLEEP=${2:-300}
 OUT=BENCH_TPU_EVIDENCE.jsonl
 for i in $(seq 1 "$MAX"); do
     date -Is
-    if timeout 240 python -c \
+    # -k: a backend-init hang inside a GIL-holding C call never processes
+    # SIGTERM (observed: a probe outlived its timeout by 20+ min); escalate
+    # to SIGKILL.
+    if timeout -k 30 240 python -c \
         "import jax; assert jax.devices()[0].platform != 'cpu'" \
         2>/dev/null; then
         echo "probe $i: tunnel alive; running the evidence list"

@@ -23,7 +23,7 @@ run_mode() {  # run_mode [bench args...]
     d=$(python bench.py --print-deadline "$@") || d=4000
     t=$((d + 1350))
     echo "=== $(date -Is) bench.py $* (deadline ${d}s, timeout ${t}s)" >&2
-    timeout "$t" python bench.py "$@" 2> >(tail -5 >&2) | tail -1 | \
+    timeout -k 60 "$t" python bench.py "$@" 2> >(tail -5 >&2) | tail -1 | \
         tee -a "$OUT"
 }
 run_mode                           # north-star
@@ -37,7 +37,7 @@ run_mode --fused-regime            # two full CNN-clique compiles
 for pargs in "" "--cnn"; do
     echo "=== $(date -Is) profile_round.py $pargs" >&2
     # shellcheck disable=SC2086
-    timeout 2400 python scripts/profile_round.py $pargs \
+    timeout -k 60 2400 python scripts/profile_round.py $pargs \
         2> >(tail -3 >&2) | tail -1 | tee -a "$OUT"
 done
 echo "done; rows appended to $OUT" >&2
