@@ -85,10 +85,24 @@ st_tp = shard_state(sim_tp.init_nodes(jax.random.PRNGKey(2)), mesh_tp)
 st_tp, rep_tp = sim_tp.start(st_tp, n_rounds=2, key=jax.random.PRNGKey(3))
 acc_tp = rep_tp.curves(local=False)["accuracy"]
 
+# Explicit-collectives leg: ring attention's ppermute schedule over the
+# SAME global mesh - on the cluster the ring hops cross the process
+# boundary (the DCN path of the comm backend), which GSPMD-only legs
+# above never exercise.
+from gossipy_tpu.parallel.collectives import ring_attention
+kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+q_r = jax.random.normal(kq, (32, 8))
+k_r = jax.random.normal(kk, (32, 8))
+v_r = jax.random.normal(kv, (32, 8))
+ring_sum = float(jax.jit(
+    lambda a, b, c: (ring_attention(a, b, c, mesh, causal=True) ** 2).sum()
+)(q_r, k_r, v_r))
+
 print("RESULT " + json.dumps({"proc": int(sys.argv[3]),
                               "acc": [round(float(a), 6) for a in acc],
                               "acc_tp": [round(float(a), 6)
-                                         for a in acc_tp]}),
+                                         for a in acc_tp],
+                              "ring_sum": round(ring_sum, 5)}),
       flush=True)
 """
 
@@ -165,4 +179,11 @@ def test_two_process_cluster_runs_one_gossip_program():
     tp1 = _result(outs[1][0])["acc_tp"]
     tp_single = _result(outs[2][0])["acc_tp"]
     assert tp0 == tp1 and np.isfinite(tp0).all()
+    # Ring-attention leg: the explicit ppermute ring crossed the process
+    # boundary and produced the same result as the single-process mesh.
+    ring0 = _result(outs[0][0])["ring_sum"]
+    ring1 = _result(outs[1][0])["ring_sum"]
+    ring_single = _result(outs[2][0])["ring_sum"]
+    assert ring0 == ring1
+    np.testing.assert_allclose(ring0, ring_single, rtol=1e-5)
     np.testing.assert_allclose(tp_single, tp0, atol=1e-5)
