@@ -517,6 +517,84 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
     })
 
 
+def bench_ring_attention(s_len: int = 8192) -> None:
+    """Flash-attention kernel vs XLA dense attention at sequence ``s_len``.
+
+    Single-chip, one head, head dim 128, bf16, causal — the kernel's
+    design regime (the [S, S] score block stays in VMEM instead of
+    round-tripping HBM between the two matmuls). The flash leg is
+    TPU-only: pallas interpreter mode is not a meaningful timing, so
+    off-TPU the row carries the dense timing plus an explicit skip reason
+    (the fused-regime pattern). Prints ONE JSON line.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gossipy_tpu.ops.attention import flash_attention
+
+    if DEGRADED:
+        s_len = min(s_len, 512)
+    dim = 128
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (s_len, dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (s_len, dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (s_len, dim), jnp.bfloat16)
+
+    def dense(q, k, v):
+        s = (q.astype(jnp.float32) @ k.astype(jnp.float32).T
+             ) / np.sqrt(dim)
+        i = jnp.arange(s_len)
+        s = jnp.where(i[None, :] > i[:, None], -1e30, s)
+        return (jax.nn.softmax(s, axis=-1) @ v.astype(jnp.float32)
+                ).astype(q.dtype)
+
+    reps = 20
+
+    def time_fn(fn) -> float:
+        f = jax.jit(fn)
+        out = f(q, k, v)
+        jax.block_until_ready(out)  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    dense_ms = time_fn(dense)
+    flash_ms = None
+    err = None
+    if jax.default_backend() != "tpu":
+        err = ("flash leg skipped off-TPU (pallas interpreter mode is not "
+               "a meaningful timing)")
+    else:
+        try:
+            flash_ms = time_fn(
+                lambda q, k, v: flash_attention(q, k, v, causal=True))
+        except Exception as e:  # kernel unavailable on this backend
+            err = repr(e)[:200]
+    print(f"[ring-attn] S={s_len}: dense {dense_ms:.2f} ms, flash "
+          f"{flash_ms if flash_ms is None else round(flash_ms, 2)} ms"
+          + (f" (error: {err})" if err else ""), file=sys.stderr)
+    speedup = (dense_ms / flash_ms) if flash_ms else None
+    emit({
+        "metric": "flash_attention_speedup",
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x_vs_xla_dense",
+        "vs_baseline": round(speedup, 3) if speedup else None,
+        "raw": {
+            "s_len": s_len, "head_dim": dim, "dtype": "bfloat16",
+            "causal": True, "reps": reps,
+            "dense_ms": round(dense_ms, 3),
+            "flash_ms": (round(flash_ms, 3)
+                         if flash_ms is not None else None),
+            "error": err,
+            "note": "single chip, one head; the sequence-parallel form is "
+                    "collectives.ring_attention(flash=True)",
+        },
+    })
+
+
 def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
     """Pallas ``fused_merge`` in its design regime: CNN-sized params, clique
     fan-in (every mailbox slot regularly occupied), MERGE_UPDATE deliver.
@@ -806,6 +884,9 @@ def main():
     elif "--fused-regime" in sys.argv:
         mode, mode_arg = "fused", _mode_arg("--fused-regime", default=40,
                                             minimum=1)
+    elif "--ring-attn" in sys.argv:
+        mode, mode_arg = "ring-attn", _mode_arg("--ring-attn", default=8192,
+                                                minimum=16)
     elif "--to-acc" in sys.argv:
         try:
             mode_arg = float(sys.argv[sys.argv.index("--to-acc") + 1])
@@ -846,6 +927,9 @@ def main():
         return
     if mode == "fused":
         bench_fused_regime(mode_arg)
+        return
+    if mode == "ring-attn":
+        bench_ring_attention(mode_arg)
         return
     X, y = make_data()
     if mode == "to-acc":

@@ -32,6 +32,7 @@ run_mode --scale 50000
 run_mode --scale 100000            # CPU fallback alone is ~12 min
 run_mode --scale-all2all 50000
 run_mode --fused-regime            # two full CNN-clique compiles
+run_mode --ring-attn 8192          # flash kernel vs XLA dense attention
 # Phase attribution for the MFU attack (VERDICT #2) — grab it while the
 # tunnel is up; rows are self-labeled with backend/device_kind.
 for pargs in "" "--cnn"; do

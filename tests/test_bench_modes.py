@@ -162,3 +162,20 @@ def test_watchdog_degrades_on_wedged_accel_run():
     assert row["raw"]["backend"] == "cpu"
     assert row["raw"]["degrade_reason"] == "wedged_after_probe"
     assert row["value"] > 0
+
+
+def test_ring_attn_json_contract(bench, capfd, monkeypatch):
+    """--ring-attn off-TPU: dense timing measured, flash leg skipped with
+    an explicit reason; shrunk sizes under the degraded label."""
+    monkeypatch.setattr(bench, "DEGRADED", True)
+    bench.bench_ring_attention(s_len=64)
+    row = last_json(capfd)
+    assert row["metric"] == "flash_attention_speedup"
+    raw = row["raw"]
+    assert raw["s_len"] == 64 and raw["dense_ms"] > 0
+    import jax
+    if jax.default_backend() != "tpu":
+        assert row["value"] is None
+        assert "skipped off-TPU" in raw["error"]
+    else:
+        assert row["value"] is not None
