@@ -48,6 +48,10 @@ _NEG = -1e30
 # Default query rows per program. 128 rows x 128-lane tiles feed the MXU
 # full systolic-array slices; chunks shorter than this run as one block.
 BLOCK_Q = 128
+# Default key rows per inner block. The kernel's VMEM footprint per program
+# is O(block_q·block_k) scores + O(block_k·D) keys/values regardless of the
+# chunk length, so long sequences never overflow VMEM.
+BLOCK_K = 512
 
 
 def hop_update_reference(q, k_c, v_c, m, l, acc, q_off, k_off, scale,
@@ -70,38 +74,66 @@ def hop_update_reference(q, k_c, v_c, m, l, acc, q_off, k_off, scale,
     return m_new, l, acc
 
 
-def _hop_kernel(scale, causal, block_q,
+def _hop_kernel(scale, causal, block_q, block_k, n_k, sl_k,
                 offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref, a_ref,
-                om_ref, ol_ref, oa_ref):
+                om_ref, ol_ref, oa_ref,
+                m_scr, l_scr, a_scr):
+    """Grid (q_blocks, k_blocks), k fastest: the streaming-softmax carry
+    lives in VMEM scratch across a q row's k steps — per-program VMEM is
+    O(block_q·block_k), independent of the chunk length."""
     i = pl.program_id(0)
-    q = q_ref[:].astype(jnp.float32)                       # [bq, D]
-    k = k_ref[:].astype(jnp.float32)                       # [sl_k, D]
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():  # load the incoming carry for this q block
+        m_scr[:] = m_ref[:]
+        l_scr[:] = l_ref[:]
+        a_scr[:] = a_ref[:]
+
+    q = q_ref[:].astype(jnp.float32)                        # [bq, D]
+    k = k_ref[:].astype(jnp.float32)                        # [bk, D]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+    # Padded key rows (chunk length not divisible by block_k) are always
+    # masked; causal masking is by global position.
+    k_pos = (j * block_k
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+    invalid = k_pos >= sl_k
     if causal:
-        sl_k = k.shape[0]
         q_pos = (offs_ref[0] + i * block_q
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, sl_k), 0))
-        k_pos = (offs_ref[1]
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, sl_k), 1))
-        s = jnp.where(k_pos > q_pos, _NEG, s)
-    m_in = m_ref[:][:, 0]                                   # [bq]
-    l_in = l_ref[:][:, 0]
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k),
+                                            0))
+        invalid = invalid | ((offs_ref[1] + k_pos) > q_pos)
+    s = jnp.where(invalid, _NEG, s)
+    m_in = m_scr[:][:, 0]                                   # [bq]
+    l_in = l_scr[:][:, 0]
     m_new = jnp.maximum(m_in, s.max(axis=1))
     alpha = jnp.exp(m_in - m_new)
     p = jnp.exp(s - m_new[:, None])                         # stays in VMEM
-    oa_ref[:] = a_ref[:] * alpha[:, None] + p @ v_ref[:].astype(jnp.float32)
-    om_ref[:] = m_new[:, None]
-    ol_ref[:] = (l_in * alpha + p.sum(axis=1))[:, None]
+    # A fully-masked block at m_in == _NEG degenerates to p == exp(0); the
+    # zero-alpha rescale keeps it harmless only when some earlier block was
+    # real — guard explicitly so the padded tail cannot poison the carry.
+    p = jnp.where(invalid, 0.0, p)
+    a_scr[:] = a_scr[:] * alpha[:, None] + p @ v_ref[:].astype(jnp.float32)
+    m_scr[:] = m_new[:, None]
+    l_scr[:] = (l_in * alpha + p.sum(axis=1))[:, None]
+
+    @pl.when(j == n_k - 1)
+    def _flush():
+        om_ref[:] = m_scr[:]
+        ol_ref[:] = l_scr[:]
+        oa_ref[:] = a_scr[:]
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "causal", "interpret",
-                                    "block_q"))
+                                    "block_q", "block_k"))
 def _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale, causal,
-                       interpret, block_q):
+                       interpret, block_q, block_k):
     sl_q, dim = q.shape
+    sl_k = k_c.shape[0]
     dv = v_c.shape[1]
     bq = min(block_q, sl_q)
+    bk = min(block_k, sl_k)
     pad = (-sl_q) % bq
     if pad:  # pad query rows; padded rows are sliced off below
         q = jnp.pad(q, ((0, pad), (0, 0)))
@@ -109,22 +141,32 @@ def _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale, causal,
         l = jnp.pad(l, (0, pad))
         acc = jnp.pad(acc, ((0, pad), (0, 0)))
     slp = sl_q + pad
+    pad_k = (-sl_k) % bk
+    if pad_k:  # padded key rows are masked inside the kernel (k_pos bound)
+        k_c = jnp.pad(k_c, ((0, pad_k), (0, 0)))
+        v_c = jnp.pad(v_c, ((0, pad_k), (0, 0)))
+    n_k = (sl_k + pad_k) // bk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # (q_off, k_off) int32[2]
-        grid=(slp // bq,),
+        grid=(slp // bq, n_k),
         in_specs=[
-            pl.BlockSpec((bq, dim), lambda i, o: (i, 0)),          # q
-            pl.BlockSpec(k_c.shape, lambda i, o: (0, 0)),          # k chunk
-            pl.BlockSpec(v_c.shape, lambda i, o: (0, 0)),          # v chunk
-            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),            # m
-            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),            # l
-            pl.BlockSpec((bq, dv), lambda i, o: (i, 0)),           # acc
+            pl.BlockSpec((bq, dim), lambda i, j, o: (i, 0)),       # q
+            pl.BlockSpec((bk, dim), lambda i, j, o: (j, 0)),       # k block
+            pl.BlockSpec((bk, dv), lambda i, j, o: (j, 0)),        # v block
+            pl.BlockSpec((bq, 1), lambda i, j, o: (i, 0)),         # m
+            pl.BlockSpec((bq, 1), lambda i, j, o: (i, 0)),         # l
+            pl.BlockSpec((bq, dv), lambda i, j, o: (i, 0)),        # acc
         ],
         out_specs=[
-            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),
-            pl.BlockSpec((bq, 1), lambda i, o: (i, 0)),
-            pl.BlockSpec((bq, dv), lambda i, o: (i, 0)),
+            pl.BlockSpec((bq, 1), lambda i, j, o: (i, 0)),
+            pl.BlockSpec((bq, 1), lambda i, j, o: (i, 0)),
+            pl.BlockSpec((bq, dv), lambda i, j, o: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, dv), jnp.float32),
         ],
     )
     # Under shard_map's varying-axes checking the out avals must declare
@@ -142,7 +184,7 @@ def _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale, causal,
         return jax.ShapeDtypeStruct(shape, jnp.float32)
 
     om, ol, oa = pl.pallas_call(
-        functools.partial(_hop_kernel, scale, causal, bq),
+        functools.partial(_hop_kernel, scale, causal, bq, bk, n_k, sl_k),
         grid_spec=grid_spec,
         out_shape=[sds((slp, 1)), sds((slp, 1)), sds((slp, dv))],
         interpret=interpret,
@@ -205,7 +247,7 @@ def _hop_bwd_math(scale, causal, res, g):
 
 @functools.lru_cache(maxsize=None)
 def _make_hop_update(scale: float, causal: bool, interpret: bool,
-                     block_q: int):
+                     block_q: int, block_k: int):
     """Build the custom-vjp'd hop update for static (scale, causal, mode).
 
     Forward runs the pallas kernel; backward is :func:`_hop_bwd_math`.
@@ -213,7 +255,7 @@ def _make_hop_update(scale: float, causal: bool, interpret: bool,
     @jax.custom_vjp
     def f(q, k_c, v_c, m, l, acc, offs):
         return _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale,
-                                  causal, interpret, block_q)
+                                  causal, interpret, block_q, block_k)
 
     def fwd(q, k_c, v_c, m, l, acc, offs):
         return f(q, k_c, v_c, m, l, acc, offs), (q, k_c, v_c, m, l, acc,
@@ -229,7 +271,7 @@ def _make_hop_update(scale: float, causal: bool, interpret: bool,
 def flash_hop_update(q, k_c, v_c, m, l, acc, q_off, k_off, scale,
                      causal: bool = False,
                      interpret: Optional[bool] = None,
-                     block_q: int = BLOCK_Q):
+                     block_q: int = BLOCK_Q, block_k: int = BLOCK_K):
     """One ring-attention hop as a fused pallas kernel.
 
     ``q`` [sl_q, D] resident query block; ``k_c``/``v_c`` [sl_k, D]/[sl_k,
@@ -248,13 +290,13 @@ def flash_hop_update(q, k_c, v_c, m, l, acc, q_off, k_off, scale,
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     fn = _make_hop_update(float(scale), bool(causal), bool(interpret),
-                          int(block_q))
+                          int(block_q), int(block_k))
     return fn(q, k_c, v_c, m, l, acc, offs)
 
 
 def flash_attention(q, k, v, causal: bool = False,
                     interpret: Optional[bool] = None,
-                    block_q: int = BLOCK_Q):
+                    block_q: int = BLOCK_Q, block_k: int = BLOCK_K):
     """Single-device flash attention: softmax(q k^T / sqrt(D)) v with the
     score matrix blocked through VMEM (one hop over the full sequence).
 
@@ -269,5 +311,5 @@ def flash_attention(q, k, v, causal: bool = False,
     acc0 = jnp.zeros((s_len, v.shape[1]), jnp.float32)
     m, l, acc = flash_hop_update(q, k, v, m0, l0, acc0, 0, 0, scale,
                                  causal=causal, interpret=interpret,
-                                 block_q=block_q)
+                                 block_q=block_q, block_k=block_k)
     return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q.dtype)

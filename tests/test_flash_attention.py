@@ -55,6 +55,23 @@ def test_flash_pads_query_rows():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_tiles_and_pads_key_blocks(causal):
+    """block_k < S with a ragged tail (24 = 16 + 8 padded) must stream the
+    carry through VMEM scratch across k blocks without the padded tail
+    poisoning the statistics."""
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    S, D = 24, 8
+    q = jax.random.normal(kq, (S, D))
+    k = jax.random.normal(kk, (S, D))
+    v = jax.random.normal(kv, (S, D))
+    out = flash_attention(q, k, v, causal=causal, interpret=True,
+                          block_q=8, block_k=16)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_hop_update_matches_reference_mid_stream():
     """A hop with a NON-initial carry (mid-ring state) must rescale the
     incoming statistics exactly like the jnp body."""
