@@ -27,7 +27,10 @@ def dense_attention(q, k, v, causal=False):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("dtype", [
+    jnp.float32,
+    pytest.param(jnp.bfloat16, marks=pytest.mark.slow),  # lane budget
+])
 def test_flash_matches_dense(causal, dtype):
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
@@ -55,6 +58,7 @@ def test_flash_pads_query_rows():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow  # lane budget; the ragged-tail math is the same path
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_tiles_and_pads_key_blocks(causal):
     """block_k < S with a ragged tail (24 = 16 + 8 padded) must stream the
@@ -93,6 +97,7 @@ def test_hop_update_matches_reference_mid_stream():
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
 
 
+@pytest.mark.slow  # lane budget; the slow ring test covers grads too
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_gradients_match_dense(causal):
     """The custom vjp (recompute backward) must match autodiff through the
