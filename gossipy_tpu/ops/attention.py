@@ -183,10 +183,18 @@ def _hop_update_pallas(q, k_c, v_c, m, l, acc, offs, scale, causal,
             return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
         return jax.ShapeDtypeStruct(shape, jnp.float32)
 
+    n_q = slp // bq
     om, ol, oa = pl.pallas_call(
         functools.partial(_hop_kernel, scale, causal, bq, bk, n_k, sl_k),
         grid_spec=grid_spec,
         out_shape=[sds((slp, 1)), sds((slp, 1)), sds((slp, dv))],
+        # Scheduler hint (pallas_guide.md §13): 2·bq·bk·(dim + dv) MXU
+        # flops per program, exp dominates the transcendentals.
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_q * n_k * bq * bk * (dim + dv),
+            bytes_accessed=(slp * (dim + dv + 2) + n_q * n_k * bk
+                            * (dim + dv)) * 4,
+            transcendentals=n_q * n_k * bq * (bk + 1)),
         interpret=interpret,
     )(offs.astype(jnp.int32), q, k_c, v_c,
       m.astype(jnp.float32)[:, None], l.astype(jnp.float32)[:, None],
