@@ -862,8 +862,29 @@ def _mode_arg(flag: str, default: int, minimum: int) -> int:
     return val
 
 
+_USAGE = """usage: python bench.py [MODE]
+
+Driver contract: prints ONE JSON line; degrades to a labeled CPU fallback
+when the accelerator is unreachable or wedges mid-run.
+
+modes (default: the 100-node north-star, ours vs the live reference):
+  --mfu [ROUNDS]            CNN-config MFU vs the chip's bf16 peak
+  --scale [N]               N-node rounds/s over a CSR SparseTopology
+  --scale-all2all [N]       Koloskova variant at N nodes, sparse mixing
+  --fused-regime [ROUNDS]   pallas fused merge vs XLA gather+blend
+  --ring-attn [S]           flash-attention kernel vs XLA dense attention
+  --to-acc TARGET           wall-clock to reach TARGET global accuracy
+  --print-deadline [MODE]   print the mode's watchdog deadline and exit
+
+env: GOSSIPY_TPU_BENCH_DEADLINE overrides the watchdog deadline (seconds).
+"""
+
+
 def main():
     global DEGRADED
+    if "-h" in sys.argv or "--help" in sys.argv:
+        print(_USAGE)
+        return
     if "--_degraded" in sys.argv:
         DEGRADED = True
         sys.argv.remove("--_degraded")
