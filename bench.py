@@ -883,7 +883,10 @@ env: GOSSIPY_TPU_BENCH_DEADLINE overrides the watchdog deadline (seconds).
 def main():
     global DEGRADED
     if "-h" in sys.argv or "--help" in sys.argv:
-        print(_USAGE)
+        try:
+            print(_USAGE)
+        except BrokenPipeError:  # `bench.py --help | head` closes early
+            pass
         return
     if "--_degraded" in sys.argv:
         DEGRADED = True
