@@ -57,7 +57,11 @@ def build_sim(cnn: bool, n_nodes: int, local_epochs: int = 1,
         yte = rng.integers(0, 10, n_test)
         dh = ClassificationDataHandler(X, y, Xte, yte)
         model, n_classes, in_shape = CIFAR10Net(), 10, (32, 32, 3)
-        dtype = jnp.bfloat16
+        # bf16 is the TPU measurement dtype; on CPU it is emulated ~10x
+        # slower, so the labeled fallback profiles in fp32 (bench_mfu's
+        # degraded-path convention).
+        import jax
+        dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else None
     else:
         d = 57
         X = rng.normal(size=(46 * n_nodes, d)).astype(np.float32)
@@ -155,6 +159,9 @@ def main() -> None:
             "train_one_epoch": round(train, 3),
             "exchange_and_overhead": round(no_eval - train, 3),
         },
+        "note": "differential attribution assumes steady state; at small "
+                "--rounds the legs carry run-to-run noise and can go "
+                "slightly negative",
         "xla_per_round": {
             "gflops": round(flops / 1e9, 3) if np.isfinite(flops) else None,
             "gbytes_accessed": (round(bytes_ac / 1e9, 3)
