@@ -3,42 +3,58 @@
 # evidence"): run every bench mode on the real chip and append the raw JSON
 # lines to BENCH_TPU_EVIDENCE.jsonl for BASELINE.md.
 #
-# Each mode's outer timeout is sized as probe (150s) + the watchdog deadline
-# bench.py computes for that mode + CPU-fallback headroom, so even a mid-run
-# tunnel wedge ends inside the budget with a labeled degraded row (bench.py
-# kills the wedged accelerator child itself and re-runs on CPU).
+# Ordering is tunnel-window-aware (2026-07-31: the tunnel stayed healthy for
+# ~30 min, long enough for exactly two modes, then wedged): the modes still
+# missing a genuine TPU row run FIRST, cheapest first, so a short window
+# banks the most new evidence; already-captured modes rerun at the end as
+# second samples. Full per-mode stderr lands in evidence_logs/ (the earlier
+# tail-5 filter truncated the one traceback of the --scale on-TPU crash).
 #
 # Usage: bash scripts/run_tpu_evidence.sh   (from the repo root)
 set -u
 cd "$(dirname "$0")/.."
 OUT=BENCH_TPU_EVIDENCE.jsonl
-echo "# $(date -Is) tpu evidence run" >> "$OUT"
+# One log dir per attempt: the poll loop reruns this script on every
+# successful probe, and a plain truncating redirect would destroy attempt
+# N's traceback the moment attempt N+1 starts.
+LOGDIR=evidence_logs/$(date +%Y%m%dT%H%M%S)
+mkdir -p "$LOGDIR"
+echo "# $(date -Is) tpu evidence run (logs: $LOGDIR)" >> "$OUT"
 # Single source of truth for the budget: bench.py owns the mode-aware
 # watchdog deadline (main(), incl. any GOSSIPY_TPU_BENCH_DEADLINE override);
 # the script queries it with --print-deadline (jax-free, answers even while
 # the tunnel is wedged) and derives the outer timeout as probe (150s) +
 # deadline + CPU-fallback headroom (1200s), so the two can never drift.
 run_mode() {  # run_mode [bench args...]
-    local d t
+    local tag d t
+    tag=$(echo "mode${*:-_northstar}" | tr ' /' '__')
     d=$(python bench.py --print-deadline "$@") || d=4000
     t=$((d + 1350))
     echo "=== $(date -Is) bench.py $* (deadline ${d}s, timeout ${t}s)" >&2
-    timeout -k 60 "$t" python bench.py "$@" 2> >(tail -5 >&2) | tail -1 | \
-        tee -a "$OUT"
+    JAX_TRACEBACK_FILTERING=off timeout -k 60 "$t" python bench.py "$@" \
+        2> "$LOGDIR/$tag.err" | tail -1 | tee -a "$OUT"
+    tail -3 "$LOGDIR/$tag.err" >&2
 }
-run_mode                           # north-star
-run_mode --mfu 50
-run_mode --scale 50000
-run_mode --scale 100000            # CPU fallback alone is ~12 min
-run_mode --scale-all2all 50000
-run_mode --fused-regime            # two full CNN-clique compiles
+# --- still missing a genuine TPU row, cheapest first ---
 run_mode --ring-attn 8192          # flash kernel vs XLA dense attention
-# Phase attribution for the MFU attack (VERDICT #2) — grab it while the
-# tunnel is up; rows are self-labeled with backend/device_kind.
+# Phase attribution for the MFU attack (VERDICT #2); rows are self-labeled.
 for pargs in "" "--cnn"; do
     echo "=== $(date -Is) profile_round.py $pargs" >&2
     # shellcheck disable=SC2086
-    timeout -k 60 2400 python scripts/profile_round.py $pargs \
-        2> >(tail -3 >&2) | tail -1 | tee -a "$OUT"
+    JAX_TRACEBACK_FILTERING=off timeout -k 60 2400 \
+        python scripts/profile_round.py $pargs \
+        2> "$LOGDIR/profile${pargs:-_northstar}.err" | tail -1 | tee -a "$OUT"
+    tail -3 "$LOGDIR/profile${pargs:-_northstar}.err" >&2
 done
+run_mode --fused-regime            # two full CNN-clique compiles
+run_mode --scale-all2all 50000
+# The --scale modes crashed on-TPU in the 10:14 window (rc=1 at 27 min /
+# 2.5 min; traceback lost to the old tail-5 filter) — run them late so a
+# short window is not burned on a known-crashing mode, with full stderr
+# kept this time.
+run_mode --scale 50000
+run_mode --scale 100000
+# --- second samples of the rows already captured 2026-07-31 10:14-10:45 ---
+run_mode                           # north-star (720.32 r/s captured)
+run_mode --mfu 50                  # 0.0039 captured
 echo "done; rows appended to $OUT" >&2
