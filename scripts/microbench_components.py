@@ -1,0 +1,197 @@
+"""Component microbenchmarks for the CNN round program (the MFU attack).
+
+The MFU row (BASELINE.md round 3: 0.0039, 261 ms/round) says the flagship
+CNN round program is overhead-bound, not FLOP-bound. This script times the
+round's candidate cost centers IN ISOLATION on whatever backend is live, so
+one short tunnel window attributes the ms/round to a component and ranks
+the rewrite candidates:
+
+- ``eval_vmap``:   global eval exactly as the engine runs it — vmap of the
+                   forward over per-node params (XLA lowers the convs with
+                   batch_group_count = n_eval_nodes).
+- ``eval_map``:    same computation as a sequential ``lax.map`` over nodes —
+                   each conv keeps its natural [E] batch shape. If this beats
+                   eval_vmap on TPU, the batched-weights lowering is the MFU
+                   problem, not the eval schedule.
+- ``eval_single``: ONE node's params on the same [E] eval batch — the
+                   irreducible conv-forward floor (x n_eval_nodes for the
+                   fair comparison).
+- ``merge_slot``:  the deliver slot's gather+blend half — fetch every
+                   node's peer snapshot from the [D, N, ...] history ring
+                   and average it into the local params (the engine's
+                   unfused MERGE step, engine.py ``_gather_peer`` +
+                   ``handler.call``'s merge).
+- ``train_slot``:  the deliver slot's update half — the vmapped local-SGD
+                   pass over all N nodes (the engine's per-slot
+                   ``handler.update``).
+- ``snapshot``:    the per-round history-ring write (dynamic_update_slice
+                   of all N nodes' params), timed with the ring donated so
+                   it measures the in-place write the scanned round
+                   performs, not a ring copy.
+
+Prints ONE JSON line with per-component ms. Backend-labeled like the bench
+rows; off-TPU it is a smoke test of the harness, not a measurement.
+
+Usage (repo root):
+    python scripts/microbench_components.py            # CNN config sizes
+    python scripts/microbench_components.py --small    # CPU smoke sizes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _timed(fn, *args, reps: int = 10) -> float:
+    """Compile, then steady-state ms per call."""
+    import jax
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--small", action="store_true",
+                    help="tiny sizes (CPU smoke test)")
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args()
+
+    import _virtual_mesh
+    ok, detail = _virtual_mesh.probe_backend_alive()
+    if not ok:
+        print(f"[micro] backend unreachable ({detail}); re-exec on CPU",
+              file=sys.stderr)
+        env = _virtual_mesh.virtual_mesh_env(1, extra_path=_REPO)
+        # Shrink to the smoke sizes: the full CNN config takes tens of
+        # minutes on this 1-core host and the CPU row is only a harness
+        # check anyway (same convention as bench.py's --_degraded).
+        argv = [sys.executable] + sys.argv
+        if "--small" not in argv:
+            argv.append("--small")
+        os.execve(sys.executable, argv, env)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from gossipy_tpu import enable_compilation_cache
+    from gossipy_tpu.core import CreateModelMode
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import CIFAR10Net
+
+    enable_compilation_cache()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.small:
+        n_nodes, n_eval_nodes, e_sz, shard = 8, 2, 64, 32
+    else:
+        # bench_mfu's config: 100 nodes, 10 sampled eval nodes, 1280-sample
+        # eval set, 128-sample shards (bench.py bench_mfu).
+        n_nodes, n_eval_nodes, e_sz, shard = 100, 10, 1280, 128
+    dtype = jnp.bfloat16 if on_tpu else None
+
+    handler = SGDHandler(
+        model=CIFAR10Net(), loss=losses.cross_entropy,
+        optimizer=optax.chain(optax.add_decayed_weights(1e-3), optax.sgd(0.05)),
+        local_epochs=1, batch_size=32, n_classes=10, input_shape=(32, 32, 3),
+        create_model_mode=CreateModelMode.MERGE_UPDATE, compute_dtype=dtype)
+
+    key = jax.random.PRNGKey(0)
+    states = jax.vmap(handler.init)(jax.random.split(key, n_nodes))
+    rng = np.random.default_rng(0)
+    xe = jnp.asarray(rng.normal(size=(e_sz, 32, 32, 3)), jnp.float32)
+    ye = jnp.asarray(rng.integers(0, 10, e_sz))
+    me = jnp.ones((e_sz,), jnp.float32)
+    xtr = jnp.asarray(rng.normal(size=(n_nodes, shard, 32, 32, 3)), jnp.float32)
+    ytr = jnp.asarray(rng.integers(0, 10, (n_nodes, shard)))
+    mtr = jnp.ones((n_nodes, shard), jnp.float32)
+
+    eval_states = jax.tree.map(lambda l: l[:n_eval_nodes], states)
+
+    def eval_vmap(st):
+        return jax.vmap(lambda m: handler.evaluate(m, (xe, ye, me)))(st)
+
+    def eval_map(st):
+        return jax.lax.map(lambda m: handler.evaluate(m, (xe, ye, me)), st)
+
+    one_state = jax.tree.map(lambda l: l[0], states)
+
+    def eval_single(st):
+        return handler.evaluate(st, (xe, ye, me))
+
+    def train_slot(st):
+        keys = jax.random.split(jax.random.PRNGKey(1), n_nodes)
+        return jax.vmap(handler.update)(st, (xtr, ytr, mtr), keys)
+
+    D = 2
+    hist = jax.tree.map(
+        lambda l: jnp.broadcast_to(l, (D,) + l.shape).copy(), states.params)
+    senders = jnp.asarray(rng.integers(0, n_nodes, n_nodes), jnp.int32)
+
+    def merge_slot(p, h):
+        peer = jax.tree.map(lambda hb: hb[0, senders], h)
+        return jax.tree.map(lambda a, b: 0.5 * a + 0.5 * b, p, peer)
+
+    def snapshot(h, p):
+        return jax.tree.map(
+            lambda hb, pb: jax.lax.dynamic_update_index_in_dim(hb, pb, 1, 0),
+            h, p)
+
+    def _timed_donated(fn, h, p, reps: int) -> float:
+        """Steady-state ms for the ring write with ``h`` donated — each
+        rep's output ring is threaded back in, so XLA updates the buffer
+        in place exactly as the scanned round program does."""
+        f = jax.jit(fn, donate_argnums=0)
+        h = f(h, p)
+        jax.block_until_ready(h)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            h = f(h, p)
+        jax.block_until_ready(h)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    res = {
+        "eval_vmap_ms": round(_timed(eval_vmap, eval_states,
+                                     reps=args.reps), 3),
+        "eval_map_ms": round(_timed(eval_map, eval_states,
+                                    reps=args.reps), 3),
+        "eval_single_x_nodes_ms": round(
+            _timed(eval_single, one_state, reps=args.reps) * n_eval_nodes, 3),
+        "merge_slot_ms": round(_timed(merge_slot, states.params, hist,
+                                      reps=args.reps), 3),
+        "train_slot_ms": round(_timed(train_slot, states,
+                                      reps=args.reps), 3),
+        "snapshot_ms": round(_timed_donated(snapshot, hist, states.params,
+                                            args.reps), 3),
+    }
+    print(json.dumps({
+        "metric": "cnn_component_ms",
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "n_nodes": n_nodes, "n_eval_nodes": n_eval_nodes,
+        "eval_set": e_sz, "shard": shard,
+        "dtype": "bfloat16" if dtype is not None else "float32",
+        "components": res,
+        "note": "eval_vmap is the engine's path; eval_single x nodes is the "
+                "conv floor; mfu row context: 261 ms/round full program",
+    }))
+
+
+if __name__ == "__main__":
+    main()

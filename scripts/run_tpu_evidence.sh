@@ -25,27 +25,31 @@ echo "# $(date -Is) tpu evidence run (logs: $LOGDIR)" >> "$OUT"
 # the script queries it with --print-deadline (jax-free, answers even while
 # the tunnel is wedged) and derives the outer timeout as probe (150s) +
 # deadline + CPU-fallback headroom (1200s), so the two can never drift.
-run_mode() {  # run_mode [bench args...]
-    local tag d t
-    tag=$(echo "mode${*:-_northstar}" | tr ' /' '__')
-    d=$(python bench.py --print-deadline "$@") || d=4000
-    t=$((d + 1350))
-    echo "=== $(date -Is) bench.py $* (deadline ${d}s, timeout ${t}s)" >&2
-    JAX_TRACEBACK_FILTERING=off timeout -k 60 "$t" python bench.py "$@" \
+# run_script <tag> <timeout_s> <cmd...>: the one place the invocation
+# policy lives — timestamp header, traceback filtering off, full stderr to
+# $LOGDIR/<tag>.err (last lines echoed), last stdout line appended to $OUT.
+run_script() {
+    local tag=$1 t=$2
+    shift 2
+    echo "=== $(date -Is) $* (timeout ${t}s)" >&2
+    JAX_TRACEBACK_FILTERING=off timeout -k 60 "$t" "$@" \
         2> "$LOGDIR/$tag.err" | tail -1 | tee -a "$OUT"
     tail -3 "$LOGDIR/$tag.err" >&2
+}
+run_mode() {  # run_mode [bench args...]
+    local d
+    d=$(python bench.py --print-deadline "$@") || d=4000
+    run_script "$(echo "mode${*:-_northstar}" | tr ' /' '__')" \
+        $((d + 1350)) python bench.py "$@"
 }
 # --- still missing a genuine TPU row, cheapest first ---
 run_mode --ring-attn 8192          # flash kernel vs XLA dense attention
 # Phase attribution for the MFU attack (VERDICT #2); rows are self-labeled.
-for pargs in "" "--cnn"; do
-    echo "=== $(date -Is) profile_round.py $pargs" >&2
-    # shellcheck disable=SC2086
-    JAX_TRACEBACK_FILTERING=off timeout -k 60 2400 \
-        python scripts/profile_round.py $pargs \
-        2> "$LOGDIR/profile${pargs:-_northstar}.err" | tail -1 | tee -a "$OUT"
-    tail -3 "$LOGDIR/profile${pargs:-_northstar}.err" >&2
-done
+run_script profile_northstar 2400 python scripts/profile_round.py
+run_script profile_cnn 2400 python scripts/profile_round.py --cnn
+# Component attribution for the 261 ms/round MFU row (eval vmap-vs-map,
+# merge/train slots, snapshot) — ~1 min of device time after compiles.
+run_script microbench 2400 python scripts/microbench_components.py
 run_mode --fused-regime            # two full CNN-clique compiles
 run_mode --scale-all2all 50000
 # The --scale modes crashed on-TPU in the 10:14 window (rc=1 at 27 min /
