@@ -251,7 +251,8 @@ PEAK_FLOPS = {
 
 
 def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
-              n_train: int | None = None, n_test: int | None = None) -> None:
+              n_train: int | None = None, n_test: int | None = None,
+              variant: str = "vanilla") -> None:
     """Model-FLOPs-utilization for the CNN north-star config.
 
     Runs the CIFAR-10 100-node CNN round program (CIFAR-shaped synthetic
@@ -261,18 +262,33 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
     reported against 1.0 "full chip" (the reference cannot run this
     workload on an accelerator at all, so there is no reference MFU).
 
+    ``variant="all2all"`` measures the same CNN workload under the
+    Koloskova All-to-All protocol (reference simul.py:720-852) instead of
+    vanilla push gossip. The two protocols bound the engine's MFU range
+    from both ends: vanilla semantics process each received message
+    individually (per-mailbox-slot masked train passes over the whole
+    population — ~24% average utilization at Poisson(1) in-degree), while
+    All2All merges the whole neighborhood in ONE ``W_eff @ P`` einsum and
+    trains each node exactly once per round (no masked waste). Both are
+    reference-exact protocols; the spread between their MFU rows is the
+    cost of per-message semantics, not engine overhead.
+
     ``n_nodes``/``n_train``/``n_test`` override the workload size (smoke
     tests; the measured MFU is only meaningful at the default scale).
     """
+    if variant not in ("vanilla", "all2all"):
+        raise ValueError(f"unknown MFU variant {variant!r} "
+                         "(a typo must not silently measure vanilla)")
     import jax
     import jax.numpy as jnp
     import optax
 
-    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+        Topology, uniform_mixing
     from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
-    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.handlers import SGDHandler, WeightedSGDHandler, losses
     from gossipy_tpu.models import CIFAR10Net
-    from gossipy_tpu.simulation import GossipSimulator
+    from gossipy_tpu.simulation import All2AllGossipSimulator, GossipSimulator
 
     rng = np.random.default_rng(0)
     # The CPU fallback cannot finish the full CNN/100-node workload in
@@ -292,7 +308,8 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
     Xte = rng.normal(size=(n_test, 32, 32, 3)).astype(np.float32)
     yte = rng.integers(0, 10, n_test)
 
-    handler = SGDHandler(
+    handler_cls = WeightedSGDHandler if variant == "all2all" else SGDHandler
+    handler = handler_cls(
         model=CIFAR10Net(), loss=losses.cross_entropy,
         optimizer=optax.chain(optax.add_decayed_weights(1e-3), optax.sgd(0.05)),
         local_epochs=1, batch_size=32, n_classes=10, input_shape=(32, 32, 3),
@@ -300,12 +317,17 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
         compute_dtype=None if DEGRADED else jnp.bfloat16)
     disp = DataDispatcher(ClassificationDataHandler(Xtr, ytr, Xte, yte),
                           n=n_nodes, eval_on_user=False)
-    sim = GossipSimulator(
-        handler,
-        Topology.random_regular(n_nodes, min(DEGREE, n_nodes - 1), seed=42,
-                                backend="networkx"),
-        disp.stacked(), delta=ROUND_LEN, protocol=AntiEntropyProtocol.PUSH,
-        sampling_eval=0.1, eval_every=1)
+    topo = Topology.random_regular(n_nodes, min(DEGREE, n_nodes - 1), seed=42,
+                                   backend="networkx")
+    if variant == "all2all":
+        sim = All2AllGossipSimulator(
+            handler, topo, disp.stacked(), delta=ROUND_LEN,
+            mixing=uniform_mixing(topo), sampling_eval=0.1, eval_every=1)
+    else:
+        sim = GossipSimulator(
+            handler, topo, disp.stacked(), delta=ROUND_LEN,
+            protocol=AntiEntropyProtocol.PUSH, sampling_eval=0.1,
+            eval_every=1)
 
     import jax.random as jrandom
     key = jrandom.PRNGKey(42)
@@ -349,12 +371,14 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
              else " (MFU null)"),
           file=sys.stderr)
     emit({
-        "metric": "mfu_cifar10_100nodes_cnn",
+        "metric": "mfu_cifar10_100nodes_cnn" + (
+            "_all2all" if variant == "all2all" else ""),
         "value": round(mfu, 4) if mfu is not None else None,
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu, 4) if mfu is not None else None,
         "raw": {
             "device_kind": kind,
+            "protocol": variant,
             "n_nodes": n_nodes,
             "ms_per_round": round(elapsed / rounds * 1e3, 2),
             "xla_flops_per_round": flops_per_round,
@@ -869,6 +893,8 @@ when the accelerator is unreachable or wedges mid-run.
 
 modes (default: the 100-node north-star, ours vs the live reference):
   --mfu [ROUNDS]            CNN-config MFU vs the chip's bf16 peak
+  --mfu-all2all [ROUNDS]    same workload under the All2All protocol (the
+                            one-einsum merge: the engine's MFU upper end)
   --scale [N]               N-node rounds/s over a CSR SparseTopology
   --scale-all2all [N]       Koloskova variant at N nodes, sparse mixing
   --fused-regime [ROUNDS]   pallas fused merge vs XLA gather+blend
@@ -897,7 +923,10 @@ def main():
 
     # Parse argv first: usage errors must not pay the backend probe.
     mode, mode_arg = "north-star", None
-    if "--mfu" in sys.argv:
+    if "--mfu-all2all" in sys.argv:
+        mode, mode_arg = "mfu-all2all", _mode_arg("--mfu-all2all",
+                                                  default=50, minimum=1)
+    elif "--mfu" in sys.argv:
         mode, mode_arg = "mfu", _mode_arg("--mfu", default=50, minimum=1)
     elif "--scale-all2all" in sys.argv:
         mode, mode_arg = "scale-all2all", _mode_arg(
@@ -942,6 +971,9 @@ def main():
     enable_compilation_cache()
     if mode == "mfu":
         bench_mfu(mode_arg)
+        return
+    if mode == "mfu-all2all":
+        bench_mfu(mode_arg, variant="all2all")
         return
     if mode == "scale":
         bench_scale(mode_arg)

@@ -50,6 +50,7 @@ run_script profile_cnn 2400 python scripts/profile_round.py --cnn
 # Component attribution for the 261 ms/round MFU row (eval vmap-vs-map,
 # merge/train slots, snapshot) — ~1 min of device time after compiles.
 run_script microbench 2400 python scripts/microbench_components.py
+run_mode --mfu-all2all 50          # the one-einsum-merge MFU upper end
 run_mode --fused-regime            # two full CNN-clique compiles
 run_mode --scale-all2all 50000
 # The --scale modes crashed on-TPU in the 10:14 window (rc=1 at 27 min /

@@ -32,15 +32,21 @@ def test_scale_small_n_keeps_fractional_split(bench, capfd):
 
 
 @pytest.mark.slow
-def test_mfu_json_contract(bench, capfd, monkeypatch):
-    """--mfu must work first-try when the tunnel returns: assert the JSON
-    shape on a tiny CPU run — MFU is null off-TPU (unknown device kind,
-    loud warning) but ms/round must be finite and the line fully labeled.
-    (CNN compile is ~30 s on this host: slow lane.)"""
+@pytest.mark.parametrize("variant,metric", [
+    ("vanilla", "mfu_cifar10_100nodes_cnn"),
+    ("all2all", "mfu_cifar10_100nodes_cnn_all2all"),
+])
+def test_mfu_json_contract(bench, capfd, monkeypatch, variant, metric):
+    """--mfu / --mfu-all2all must work first-try when the tunnel returns:
+    assert the JSON shape on a tiny CPU run — MFU is null off-TPU (unknown
+    device kind, loud warning) but ms/round must be finite and the line
+    fully labeled. (CNN compile is ~30 s on this host: slow lane.)"""
     monkeypatch.setattr(bench, "DEGRADED", True)  # fp32 + 1 round
-    bench.bench_mfu(rounds=1, n_nodes=4, n_train=64, n_test=32)
+    bench.bench_mfu(rounds=1, n_nodes=4, n_train=64, n_test=32,
+                    variant=variant)
     row = last_json(capfd)
-    assert row["metric"] == "mfu_cifar10_100nodes_cnn"
+    assert row["metric"] == metric
+    assert row["raw"]["protocol"] == variant
     assert row["unit"] == "fraction_of_peak"
     raw = row["raw"]
     assert raw["degraded"] is True and raw["backend"] in ("cpu", "tpu")
