@@ -252,7 +252,7 @@ PEAK_FLOPS = {
 
 def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
               n_train: int | None = None, n_test: int | None = None,
-              variant: str = "vanilla") -> None:
+              variant: str = "vanilla", eval_every: int = 5) -> None:
     """Model-FLOPs-utilization for the CNN north-star config.
 
     Runs the CIFAR-10 100-node CNN round program (CIFAR-shaped synthetic
@@ -272,6 +272,15 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
     trains each node exactly once per round (no masked waste). Both are
     reference-exact protocols; the spread between their MFU rows is the
     cost of per-message semantics, not engine overhead.
+
+    ``eval_every`` amortizes the evaluation pass over that many rounds
+    (round-3 phase attribution put eval at ~2/3 of round time; the
+    reference's *per-round* eval is a semantic, not a perf contract —
+    VERDICT r3 #1). FLOP accounting stays honest under the amortization:
+    per-round FLOPs are decomposed into base + eval via two 1-round
+    compiles (eval structurally on / structurally absent), and executed
+    FLOPs = rounds * base + n_eval_rounds * eval — the timed program only
+    pays eval on the rounds that actually run it.
 
     ``n_nodes``/``n_train``/``n_test`` override the workload size (smoke
     tests; the measured MFU is only meaningful at the default scale).
@@ -319,33 +328,60 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
                           n=n_nodes, eval_on_user=False)
     topo = Topology.random_regular(n_nodes, min(DEGREE, n_nodes - 1), seed=42,
                                    backend="networkx")
-    if variant == "all2all":
-        sim = All2AllGossipSimulator(
-            handler, topo, disp.stacked(), delta=ROUND_LEN,
-            mixing=uniform_mixing(topo), sampling_eval=0.1, eval_every=1)
-    else:
-        sim = GossipSimulator(
-            handler, topo, disp.stacked(), delta=ROUND_LEN,
-            protocol=AntiEntropyProtocol.PUSH, sampling_eval=0.1,
-            eval_every=1)
+    stacked = disp.stacked()
+    # Three structurally-different round programs over the same workload:
+    # the TIMED one (eval amortized over eval_every rounds), plus two
+    # 1-round FLOP-decomposition programs — eval forced every round vs eval
+    # structurally absent (no eval keys in the data dict) — whose per-round
+    # FLOP difference is the eval pass's cost in XLA's own count.
+    no_eval = {k: v for k, v in stacked.items()
+               if k not in ("x_eval", "y_eval", "xte", "yte", "mte")}
 
+    def make_sim(data, ev):
+        if variant == "all2all":
+            return All2AllGossipSimulator(
+                handler, topo, data, delta=ROUND_LEN,
+                mixing=uniform_mixing(topo), sampling_eval=0.1,
+                eval_every=ev)
+        return GossipSimulator(
+            handler, topo, data, delta=ROUND_LEN,
+            protocol=AntiEntropyProtocol.PUSH, sampling_eval=0.1,
+            eval_every=ev)
+
+    sim = make_sim(stacked, eval_every)
     import jax.random as jrandom
     key = jrandom.PRNGKey(42)
     state = sim.init_nodes(key, common_init=True)
 
-    # XLA's HLO cost model counts a while/scan body ONCE regardless of trip
-    # count (verified: 1-round and 10-round programs report equal flops), so
-    # take per-round FLOPs from a 1-round program and scale by the measured
-    # round count.
-    compiled = sim.lower_start(state, n_rounds=1, key=key).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-        cost = cost[0]
-    flops_per_round = float(cost.get("flops", float("nan")))
-    if not np.isfinite(flops_per_round):
-        flops_per_round = None
-    flops_total = (flops_per_round * rounds
-                   if flops_per_round is not None else None)
+    def flops_of_one_round(s) -> float | None:
+        # XLA's HLO cost model counts a while/scan body ONCE regardless of
+        # trip count (verified: 1-round and 10-round programs report equal
+        # flops), so a 1-round program gives per-round FLOPs directly.
+        cost = s.lower_start(state, n_rounds=1, key=key).compile() \
+            .cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0]
+        f = float(cost.get("flops", float("nan")))
+        return f if np.isfinite(f) else None
+
+    # Rounds on which _maybe_eval actually evaluates (incl. the forced
+    # final-round eval).
+    n_evals = sum(1 for r in range(rounds)
+                  if (r + 1) % eval_every == 0 or r == rounds - 1)
+    f_with_eval = flops_of_one_round(make_sim(stacked, 1))
+    if DEGRADED or eval_every == 1:
+        # Off-accelerator MFU is null anyway (unknown device kind) — skip
+        # the second CNN compile and fall back to the undecomposed count.
+        f_base = None
+        flops_total = (f_with_eval * rounds
+                       if f_with_eval is not None else None)
+    else:
+        f_base = flops_of_one_round(make_sim(no_eval, 1))
+        if f_with_eval is not None and f_base is not None:
+            flops_total = rounds * f_base + \
+                n_evals * max(f_with_eval - f_base, 0.0)
+        else:
+            flops_total = None
 
     s2, _ = sim.start(state, n_rounds=rounds, key=key)  # warmup/compile
     jax.block_until_ready(s2.model.params)
@@ -380,8 +416,12 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
             "device_kind": kind,
             "protocol": variant,
             "n_nodes": n_nodes,
+            "eval_every": eval_every,
+            "n_eval_rounds": n_evals,
             "ms_per_round": round(elapsed / rounds * 1e3, 2),
-            "xla_flops_per_round": flops_per_round,
+            "xla_flops_per_round_with_eval": f_with_eval,
+            "xla_flops_per_round_base": f_base,
+            "xla_flops_executed_total": flops_total,
             "achieved_tflops_per_sec": (round(achieved / 1e12, 3)
                                         if achieved is not None else None),
             "peak_tflops_per_sec": peak / 1e12 if peak else None,
@@ -717,6 +757,57 @@ def _backend_alive() -> bool:
     return ok
 
 
+def _poll_budget(deadline: float) -> float:
+    """Seconds the pre-watchdog probe poll may spend: the
+    ``GOSSIPY_TPU_BENCH_PROBE_POLL`` override if set (0 disables polling —
+    the evidence script's setting, whose OUTER loop already polls), else
+    half the (already override-resolved) watchdog deadline. Shared by the
+    poll itself and ``--print-deadline`` so the outer-timeout contract
+    (``print-deadline + fixed headroom``) covers the poll too."""
+    import math
+    raw = os.environ.get("GOSSIPY_TPU_BENCH_PROBE_POLL", "")
+    try:
+        val = float(raw) if raw else deadline / 2.0
+        # nan parses fine but would poll forever (nan <= 0 is False every
+        # iteration); inf would crash --print-deadline's int().
+        if not math.isfinite(val) or val < 0:
+            raise ValueError(raw)
+        return val
+    except ValueError:
+        print(f"[bench] ignoring malformed GOSSIPY_TPU_BENCH_PROBE_POLL="
+              f"{raw!r}; using deadline/2", file=sys.stderr)
+        return deadline / 2.0
+
+
+def _backend_alive_with_poll(deadline: float) -> bool:
+    """Probe the backend, then keep polling for up to ``_poll_budget``
+    before giving up (VERDICT r3 #4: the driver-visible bench row should be
+    a TPU row whenever ANY window opens during its run — the tunnel has
+    repeatedly come back minutes after a wedge). ``deadline`` must already
+    be override-resolved. Each hung probe burns its own 150 s child
+    timeout, which counts against the budget.
+    """
+    budget = _poll_budget(deadline)
+    start = time.monotonic()
+    if _backend_alive():
+        return True
+    attempt = 1
+    while True:
+        remaining = budget - (time.monotonic() - start)
+        if remaining <= 0:
+            if budget > 0:
+                print(f"[bench] backend still unreachable after "
+                      f"{budget:.0f}s of polling ({attempt} probes) — "
+                      "degrading", file=sys.stderr)
+            return False
+        time.sleep(min(45.0, remaining))
+        attempt += 1
+        print(f"[bench] probe retry {attempt} "
+              f"({remaining:.0f}s of poll budget left)", file=sys.stderr)
+        if _backend_alive():
+            return True
+
+
 def _deadline_override(default: float) -> float:
     """The watchdog deadline: ``GOSSIPY_TPU_BENCH_DEADLINE`` if set and
     parsable, else ``default``. The ONE place the override is interpreted —
@@ -954,17 +1045,22 @@ def main():
         deadline = 1500.0 + 0.025 * mode_arg
     elif mode == "fused":
         deadline = 2400.0  # two full CNN-clique compiles + 2x2 passes
+    elif mode in ("mfu", "mfu-all2all"):
+        deadline = 2400.0  # up to 3 CNN compiles (FLOP decomposition + timed)
     else:
         deadline = 1500.0
+    deadline = _deadline_override(deadline)
     if "--print-deadline" in sys.argv:
         # Budget query for scripts/run_tpu_evidence.sh: the mode-aware
         # watchdog deadline lives in ONE place (here); the script derives
         # its outer timeout from this instead of re-encoding the formula.
+        # Includes the probe-poll budget so a run that spends its whole
+        # poll AND its whole deadline still fits the derived outer timeout.
         # Must not touch jax: answers even while the tunnel is wedged.
-        print(int(_deadline_override(deadline)))
+        print(int(deadline + _poll_budget(deadline)))
         return
     if not DEGRADED and not inner:
-        if not _backend_alive():
+        if not _backend_alive_with_poll(deadline):
             _degrade_to_cpu()  # does not return
         _run_with_watchdog(deadline)  # does not return
     from gossipy_tpu import enable_compilation_cache

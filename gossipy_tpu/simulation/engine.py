@@ -259,10 +259,15 @@ class GossipSimulator(SimulationEventSender):
         ~N(delta, delta/10) period (reference node.py:79,111-125).
     mailbox_slots, reply_slots : int
         Static per-(round, receiver) message capacity; overflow counts as
-        failed (the reference's Python lists are unbounded). The default 6
-        loses ~0.003% of messages under uniform peer selection at
-        degree-20 fan-in (vs ~0.3% at 4, ~4% more throughput); empty slots
-        are skipped at runtime, so unused capacity is cheap but not free.
+        failed (the reference's Python lists are unbounded).
+        ``mailbox_slots=None`` (default) derives the capacity from the
+        topology at construction: the smallest K whose Poisson tail at the
+        worst-case expected fan-in keeps per-node-round loss under 1e-3
+        (floor 6 — ~0.003% loss at degree-20 uniform fan-in — cap 64, with
+        the undersized warning if the cap binds). Hub-heavy topologies (BA
+        stars) are thereby correct by default instead of warned-at. Empty
+        slots are skipped at runtime, so unused capacity is cheap but not
+        free; pass an explicit int to pin it.
     max_fires_per_round : int | None
         Static cap on how many times an async node can fire inside one
         round window (reference node.py:111-125 fires at every multiple of
@@ -291,7 +296,7 @@ class GossipSimulator(SimulationEventSender):
                  sampling_eval: float = 0.0,
                  eval_every: int = 1,
                  sync: bool = True,
-                 mailbox_slots: int = 6,
+                 mailbox_slots: Optional[int] = None,
                  reply_slots: int = 2,
                  message_size: Optional[int] = None,
                  fused_merge: bool = False,
@@ -309,12 +314,16 @@ class GossipSimulator(SimulationEventSender):
         self.eval_every = int(eval_every)
         assert self.eval_every >= 1
         self.sync = sync
-        self.K = int(mailbox_slots)
-        self.Kr = int(reply_slots)
         if max_fires_per_round is None:
             max_fires_per_round = 1 if sync else 2
         self.F = int(max_fires_per_round)
         assert self.F >= 1
+        self._lam_max_cache: Optional[float] = None
+        if mailbox_slots is None:
+            self.K = self._derive_mailbox_slots(self._lam_max())
+        else:
+            self.K = int(mailbox_slots)
+        self.Kr = int(reply_slots)
         self._warn_if_mailbox_undersized()
 
         self.data = {k: jnp.asarray(v) for k, v in data.items()}
@@ -342,20 +351,24 @@ class GossipSimulator(SimulationEventSender):
 
     # -- setup -------------------------------------------------------------
 
-    def _warn_if_mailbox_undersized(self) -> None:
-        """Warn when the K-slot mailbox will drop a material message fraction.
+    def _lam_max(self) -> float:
+        """``_max_expected_fanin`` computed at most once per simulator —
+        the scan is O(E) (or an [N, N] matvec on dense topologies) and both
+        consumers (slot derivation + undersized warning) may want it.
+        Subclasses whose round never reads the mailbox (All2All) pin
+        ``mailbox_slots`` and no-op the warning, skipping the scan
+        entirely."""
+        if self._lam_max_cache is None:
+            self._lam_max_cache = self._max_expected_fanin()
+        return self._lam_max_cache
 
-        Overflowed messages are honestly counted as "failed", but a user on a
-        high-fan-in topology (clique at 100+ nodes, BA hubs) should hear
-        about it up front. Expected same-round fan-in of node i under
-        uniform peer sampling is ``lam_i = sum_{j in N(i)} F / deg_j``; the
-        slot-overflow probability is approximated by the Poisson tail
-        ``P(X > K)`` at ``max_i lam_i`` (delays spreading arrivals across
-        rounds make this an upper-ish estimate; replies add ~the same again
-        for PUSH_PULL).
-        """
+    def _max_expected_fanin(self) -> float:
+        """Worst-case expected same-round fan-in under uniform peer
+        sampling: ``max_i sum_{j in N(i)} F / deg_j`` (delays spreading
+        arrivals across rounds make this an upper-ish estimate; replies add
+        ~the same again for PUSH_PULL)."""
         if self.n_nodes == 0:
-            return
+            return 0.0
         deg = np.maximum(np.asarray(self.topology.degrees, dtype=np.float64), 1.0)
         inv = self.F / deg  # per-sender hit probability on each out-neighbor
         try:
@@ -366,20 +379,57 @@ class GossipSimulator(SimulationEventSender):
             # Fan-in of i = sum over SENDERS j (adj[j, i]) of F/deg_j — a
             # column sum (adjacency rows are out-neighbors; directed
             # adjacencies are allowed).
-            lam_max = float((inv @ adj).max())
-        else:
-            # CSR rows are out-neighbor lists: scatter each sender row's
-            # F/deg into its targets.
-            lam = np.zeros(self.n_nodes)
-            degrees = np.asarray(self.topology.degrees)
-            if degrees.sum():
-                np.add.at(lam, self.topology.indices, np.repeat(inv, degrees))
-            lam_max = float(lam.max())
+            return float((inv @ adj).max())
+        # CSR rows are out-neighbor lists: scatter each sender row's
+        # F/deg into its targets.
+        lam = np.zeros(self.n_nodes)
+        degrees = np.asarray(self.topology.degrees)
+        if degrees.sum():
+            np.add.at(lam, self.topology.indices, np.repeat(inv, degrees))
+        return float(lam.max())
+
+    @staticmethod
+    def _poisson_tail(lam: float, k: int) -> float:
+        """P(Poisson(lam) > k) = 1 - sum_{x<=k} e^-lam lam^x / x!.
+
+        Computed in log space (k <= _SLOT_CAP, so the loop is tiny): the
+        naive cumprod overflows to inf*0 = NaN around lam ~ 1e6 — a star
+        hub at the populations this engine targets — and a NaN here would
+        silently pin the derived mailbox at the floor AND suppress the
+        undersized warning.
+        """
+        if lam <= 0.0:
+            return 0.0
+        import math
+        logs = [-lam + x * math.log(lam) - math.lgamma(x + 1)
+                for x in range(k + 1)]
+        m = max(logs)
+        cdf = math.exp(m) * sum(math.exp(l - m) for l in logs)
+        return min(max(1.0 - cdf, 0.0), 1.0)
+
+    _SLOT_FLOOR = 6    # ~0.003% loss at degree-20 uniform fan-in
+    _SLOT_CAP = 64     # mailbox metadata stays O(N*K); cap binding warns
+
+    def _derive_mailbox_slots(self, lam_max: float) -> int:
+        """Smallest K with per-node-round overflow ``P(Poisson(lam) > K)``
+        under 1e-3, floored/capped (hub topologies become correct by
+        default; a hub hotter than the cap still warns)."""
+        k = self._SLOT_FLOOR
+        while k < self._SLOT_CAP and self._poisson_tail(lam_max, k) > 1e-3:
+            k += 1
+        return k
+
+    def _warn_if_mailbox_undersized(self) -> None:
+        """Warn when the K-slot mailbox will drop a material message
+        fraction — a lowered explicit ``mailbox_slots``, or a derived one
+        whose cap binds (hub fan-in beyond ``_SLOT_CAP``). Overflowed
+        messages are honestly counted as "failed", but the user should hear
+        about it up front.
+        """
+        lam_max = self._lam_max()
         if lam_max <= 0.0:
             return
-        # P(Poisson(lam) > K) = 1 - sum_{x<=K} e^-lam lam^x / x!
-        terms = np.cumprod([1.0] + [lam_max / x for x in range(1, self.K + 1)])
-        p_over = max(1.0 - float(np.exp(-lam_max) * terms.sum()), 0.0)
+        p_over = self._poisson_tail(lam_max, self.K)
         if p_over > 1e-3:
             import warnings
             warnings.warn(
@@ -733,8 +783,8 @@ class GossipSimulator(SimulationEventSender):
                 n_failed += n_overflow
                 state = state._replace(reply_box=rbox)
 
-            state = self._post_receive_slot(state, valid, ty, sender, extra,
-                                            base_key, r, k)
+            state = self._post_receive_slot(state, valid, ty, sender, sr,
+                                            extra, base_key, r, k)
             return state, n_failed, n_sent_replies, reply_size_total
 
         state, n_failed, n_sent_replies, reply_size_total = jax.lax.fori_loop(
@@ -746,13 +796,15 @@ class GossipSimulator(SimulationEventSender):
         return state, n_sent_replies + ex_sent, n_failed + ex_failed, \
             reply_size_total + ex_size
 
-    def _post_receive_slot(self, state: SimState, valid, ty, sender, extra,
-                           base_key, r, k) -> SimState:
+    def _post_receive_slot(self, state: SimState, valid, ty, sender,
+                           send_round, extra, base_key, r, k) -> SimState:
         """Hook after each mailbox slot is processed (token reactions...).
 
-        ``k`` is the TRACED slot index (the deliver phase rolls slots into a
-        ``fori_loop``): use it in array arithmetic / ``fold_in``, never as a
-        Python int.
+        ``send_round`` is the [N] round each slot message was SENT in — the
+        history cell carrying its payload snapshot (differs from ``r`` for
+        delayed messages). ``k`` is the TRACED slot index (the deliver phase
+        rolls slots into a ``fori_loop``): use it in array arithmetic /
+        ``fold_in``, never as a Python int.
         """
         return state
 

@@ -25,6 +25,30 @@ _K_CACHE_POP = 9500    # CacheNeigh: which parked slot to pop
 _K_CACHE_MERGE = 9501  # CacheNeigh: merge-update randomness
 
 
+def build_neighbor_table(topology) -> np.ndarray:
+    """Padded out-neighbor table ``[N, max_deg]`` int32, -1 = unused slot.
+
+    The O(N * max_deg) replacement for dense [N, N] per-peer state: variant
+    counters/caches key on the slot position of a peer in its row (CacheNeigh
+    model slots, PENS selection counters). Works for both dense and CSR
+    topologies.
+    """
+    from ..core import SparseTopology
+    n = topology.num_nodes
+    degrees = np.asarray(topology.degrees)
+    max_deg = max(int(degrees.max()) if n else 0, 1)
+    nbr_table = np.full((n, max_deg), -1, dtype=np.int32)
+    if isinstance(topology, SparseTopology):
+        rows = np.repeat(np.arange(n), degrees)
+        pos = np.arange(len(topology.indices)) - topology.indptr[rows]
+        nbr_table[rows, pos] = topology.indices
+    elif n:
+        i, j = np.nonzero(np.asarray(topology.adjacency))
+        pos = np.arange(len(i)) - np.searchsorted(i, i, side="left")
+        nbr_table[i, pos] = j
+    return nbr_table
+
+
 class PassThroughGossipSimulator(GossipSimulator):
     """Giaretta 2019 pass-through nodes (reference node.py:289-392).
 
@@ -122,22 +146,9 @@ class CacheNeighGossipSimulator(GossipSimulator):
         # itself, so a SparseTopology CacheNeigh run scales to the node
         # counts the vanilla engine reaches (a dense [N, N] slot_of table,
         # the round-2 design, was the one remaining N^2 object here).
-        from ..core import SparseTopology
-        n = self.n_nodes
-        degrees = np.asarray(self.topology.degrees)
-        max_deg = int(degrees.max()) if n else 0
-        self.max_deg = max(max_deg, 1)
-        nbr_table = np.full((n, self.max_deg), -1, dtype=np.int32)
-        if isinstance(self.topology, SparseTopology):
-            rows = np.repeat(np.arange(n), degrees)
-            pos = np.arange(len(self.topology.indices)) \
-                - self.topology.indptr[rows]
-            nbr_table[rows, pos] = self.topology.indices
-        elif n:
-            i, j = np.nonzero(np.asarray(self.topology.adjacency))
-            pos = np.arange(len(i)) - np.searchsorted(i, i, side="left")
-            nbr_table[i, pos] = j
-        self.nbr_table = jnp.asarray(nbr_table)
+        nbr = build_neighbor_table(self.topology)
+        self.max_deg = nbr.shape[1]
+        self.nbr_table = jnp.asarray(nbr)
 
     def _init_aux(self, model: ModelState, key: jax.Array):
         S = self.max_deg
@@ -244,41 +255,62 @@ class PENSGossipSimulator(GossipSimulator):
         self.m_top = int(m_top)
         self.step1_rounds = int(step1_rounds)
         self._step = 1
+        # Selection counters key on the padded out-neighbor table (the
+        # CacheNeigh pattern): O(N * max_deg) instead of the dense [N, N]
+        # the reference's per-peer dicts imply (node.py:718-721) — PENS now
+        # scales to the same populations as the rest of the engine. Senders
+        # outside a node's out-neighbor row are dropped from the counters by
+        # construction, which also guarantees phase 2 never selects a
+        # non-neighbor (on a directed graph a dense counter could).
+        nbr = build_neighbor_table(self.topology)
+        self.max_deg = nbr.shape[1]
+        self.nbr_table = jnp.asarray(nbr)
 
     def _init_aux(self, model: ModelState, key: jax.Array):
-        n, S = self.n_nodes, self.n_sampled
+        n, S, Dg = self.n_nodes, self.n_sampled, self.max_deg
         cache_params = jax.tree.map(
             lambda l: jnp.zeros((l.shape[0], S) + l.shape[1:], l.dtype),
             model.params)
         return {
-            "selected": jnp.zeros((n, n), dtype=jnp.int32),
-            "neigh_counter": jnp.zeros((n, n), dtype=jnp.int32),
+            "selected": jnp.zeros((n, Dg), dtype=jnp.int32),
+            "neigh_counter": jnp.zeros((n, Dg), dtype=jnp.int32),
             "cache_params": cache_params,
             "cache_loss": jnp.full((n, S), jnp.inf, dtype=jnp.float32),
             "cache_sender": jnp.full((n, S), -1, dtype=jnp.int32),
             "cache_count": jnp.zeros((n,), dtype=jnp.int32),
-            "best": jnp.zeros((n, n), dtype=bool),
+            "best": jnp.zeros((n, Dg), dtype=bool),
         }
 
     # -- peer selection -----------------------------------------------------
+
+    def _slot_of(self, peers: jax.Array) -> jax.Array:
+        """Slot position of each node's ``peers[i]`` in its neighbor row
+        (-1 when not an out-neighbor); [N] -> [N]."""
+        match = self.nbr_table == peers[:, None]  # [N, max_deg]
+        return jnp.where(match.any(axis=1),
+                         jnp.argmax(match, axis=1), -1).astype(jnp.int32)
 
     def _select_peers(self, state: SimState, base_key, r):
         key = self._round_key(base_key, r, _K_PEER)
         if self._step == 1:
             return self.topology.sample_peers(key)
-        best = state.aux["best"]
+        best = state.aux["best"]  # [N, max_deg] over neighbor slots
         has_best = best.any(axis=1)
         logits_best = jnp.where(best, 0.0, -jnp.inf)
-        pick_best = jax.random.categorical(key, logits_best, axis=-1)
+        pick_slot = jnp.clip(jax.random.categorical(key, logits_best, axis=-1),
+                             0, self.max_deg - 1)
+        pick_best = self.nbr_table[jnp.arange(self.n_nodes), pick_slot]
         fallback = self.topology.sample_peers(jax.random.fold_in(key, 3))
         return jnp.where(has_best, pick_best, fallback).astype(jnp.int32)
 
     def _send_gate(self, state: SimState, active, peers, base_key, r):
         if self._step == 1:
-            # selected[i, peer] += 1 at each step-1 pick (node.py:739-744).
+            # selected[i, slot(peer)] += 1 at each step-1 pick
+            # (node.py:739-744), keyed on the neighbor slot table.
             idx = jnp.arange(self.n_nodes)
-            sel = state.aux["selected"].at[idx, jnp.clip(peers, 0, self.n_nodes - 1)
-                                           ].add(active.astype(jnp.int32))
+            slot = self._slot_of(peers)
+            sel = state.aux["selected"].at[idx, jnp.clip(slot, 0, self.max_deg - 1)
+                                           ].add((active & (slot >= 0)).astype(jnp.int32))
             aux = dict(state.aux)
             aux["selected"] = sel
             state = state._replace(aux=aux)
@@ -343,11 +375,13 @@ class PENSGossipSimulator(GossipSimulator):
         model = select_nodes(flush, trained, state.model)
 
         top_senders = jnp.take_along_axis(aux["cache_sender"], top, axis=1)
-        inc = jnp.zeros((n, n), dtype=jnp.int32)
-        rows = jnp.repeat(idx[:, None], self.m_top, axis=1)
-        inc = inc.at[rows, jnp.clip(top_senders, 0, n - 1)].add(
-            (flush[:, None] & (top_senders >= 0)).astype(jnp.int32))
-        aux["neigh_counter"] = aux["neigh_counter"] + inc
+        # neigh_counter[i, slot(sender)] += 1 per flushed top model, keyed
+        # on the neighbor slot table ([N, max_deg, m_top] match — each
+        # sender id appears at most once per row, so the m_top-sum counts).
+        match = self.nbr_table[:, :, None] == top_senders[:, None, :]
+        hit = match & flush[:, None, None] & (top_senders >= 0)[:, None, :]
+        aux["neigh_counter"] = aux["neigh_counter"] + \
+            hit.sum(axis=-1).astype(jnp.int32)
 
         aux["cache_count"] = jnp.where(flush, 0, count)
         aux["cache_loss"] = jnp.where(flush[:, None], jnp.inf, aux["cache_loss"])
@@ -368,10 +402,11 @@ class PENSGossipSimulator(GossipSimulator):
 
     def _select_neighbors(self, state: SimState) -> SimState:
         """Phase transition (node.py:728-733): best_j iff counter beats the
-        base selection rate."""
+        base selection rate — per neighbor SLOT ([N, max_deg])."""
         thresh = self.m_top / self.n_sampled
         best = state.aux["neigh_counter"].astype(jnp.float32) > \
             state.aux["selected"].astype(jnp.float32) * thresh
+        best = best & (self.nbr_table >= 0)
         aux = dict(state.aux)
         aux["best"] = best
         return state._replace(aux=aux)

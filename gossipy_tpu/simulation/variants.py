@@ -79,16 +79,18 @@ class TokenizedGossipSimulator(GossipSimulator):
         aux["balance"] = balance
         return send, state._replace(aux=aux)
 
-    def _post_receive_slot(self, state: SimState, valid, ty, sender, extra,
-                           base_key, r, k) -> SimState:
+    def _post_receive_slot(self, state: SimState, valid, ty, sender,
+                           send_round, extra, base_key, r, k) -> SimState:
         # Reactions fire for messages that produce no reply (simul.py:636-639).
         no_reply = ~((ty == MessageType.PULL) | (ty == MessageType.PUSH_PULL))
         trigger = valid & no_reply
-        # Sender snapshot for the utility: this round's history cell (the
-        # round-start model). Reference utility functions in the shipped
-        # experiments are constant (main_hegedus_2021.py:59).
-        peer = self._gather_peer(
-            state, jnp.broadcast_to(r.astype(jnp.int32), sender.shape), sender)
+        # Sender snapshot for the utility: the cell the message was SENT
+        # from (its payload), not this round's — the reference computes
+        # utility on the *received* handler (simul.py:631-648), which under
+        # delay is the sent-time model. Invisible with the constant utility
+        # the shipped experiment uses (main_hegedus_2021.py:59); tested with
+        # a snapshot-sensitive utility under UniformDelay.
+        peer = self._gather_peer(state, send_round, sender)
         utility = self.utility_fun(state.model, peer)
         balance = state.aux["balance"]
         reaction = self.account.reactive(
@@ -193,6 +195,10 @@ class All2AllGossipSimulator(GossipSimulator):
                  **kwargs):
         from ..core import SparseMixing
         kwargs.setdefault("protocol", AntiEntropyProtocol.PUSH)
+        # The All2All round never reads the mailbox (the whole neighborhood
+        # mixes in one einsum/segment-sum) — don't let the derived hub-aware
+        # default allocate a dead [D, N, 64] metadata ring.
+        kwargs.setdefault("mailbox_slots", 1)
         super().__init__(*args, **kwargs)
         assert self.protocol == AntiEntropyProtocol.PUSH, \
             "All2AllNode only supports PUSH protocol."  # node.py:856-858
@@ -275,6 +281,11 @@ class All2AllGossipSimulator(GossipSimulator):
             self._ring_axis = _node_axis_entry(mesh, None)
             assert self.n_nodes % _axis_size(mesh, self._ring_axis) == 0, \
                 "node count must divide the mesh's node axes for ring_mix"
+
+    def _warn_if_mailbox_undersized(self) -> None:
+        """No-op: broadcast mixing cannot lose messages to slot overflow
+        (the mailbox exists only as engine-state plumbing here; with the
+        pinned ``mailbox_slots`` this also skips the O(E) fan-in scan)."""
 
     def _round(self, state: SimState, base_key: jax.Array, last_round=None):
         r = state.round

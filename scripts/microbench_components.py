@@ -7,8 +7,16 @@ one short tunnel window attributes the ms/round to a component and ranks
 the rewrite candidates:
 
 - ``eval_vmap``:   global eval exactly as the engine runs it — vmap of the
-                   forward over per-node params (XLA lowers the convs with
-                   batch_group_count = n_eval_nodes).
+                   forward over per-node params. Since round 4 the default
+                   ``CIFAR10Net`` conv_impl is the im2col/einsum form, so
+                   this vmaps to batched matmuls; the grouped-conv
+                   (batch_group_count) lowering the r3 MFU row measured now
+                   lives in the ``*_alt`` rows below.
+- ``eval_vmap_alt`` / ``train_slot_alt``: the same shapes under
+                   ``conv_impl="conv"`` (vmapped ``nn.Conv`` -> tiny-group
+                   grouped convolutions) — the r4 A/B attributing the
+                   einsum-conv win on this chip (CPU datapoint: train slot
+                   12.3 s conv vs 0.72 s einsum at 8 nodes).
 - ``eval_map``:    same computation as a sequential ``lax.map`` over nodes —
                    each conv keeps its natural [E] batch shape. If this beats
                    eval_vmap on TPU, the batched-weights lowering is the MFU
@@ -98,6 +106,15 @@ def main() -> None:
     enable_compilation_cache()
 
     on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu and not args.small:
+        # The probe can pass while jax still defaults to CPU (no TPU plugin,
+        # or the plugin itself falls back): the full 100-node CNN config
+        # would burn tens of minutes here for a row that is only a harness
+        # check — shrink, mirroring bench.py's DEGRADED convention.
+        print(f"[micro] backend is {jax.default_backend()!r}, not tpu; "
+              "shrinking to --small sizes (pass --small explicitly to "
+              "silence)", file=sys.stderr)
+        args.small = True
     if args.small:
         n_nodes, n_eval_nodes, e_sz, shard = 8, 2, 64, 32
     else:
@@ -166,9 +183,31 @@ def main() -> None:
         jax.block_until_ready(h)
         return (time.perf_counter() - t0) / reps * 1e3
 
+    # A/B the conv lowering (round 4): the engine's auto conv_impl is
+    # einsum (vmapped nn.Conv lowers to tiny-group grouped convs — measured
+    # 17x slower train on CPU); measure the conv impl on the same
+    # eval/train shapes so the attribution is direct on this chip.
+    alt_impl = "conv"
+    alt_handler = SGDHandler(
+        model=CIFAR10Net(conv_impl=alt_impl), loss=losses.cross_entropy,
+        optimizer=optax.chain(optax.add_decayed_weights(1e-3), optax.sgd(0.05)),
+        local_epochs=1, batch_size=32, n_classes=10, input_shape=(32, 32, 3),
+        create_model_mode=CreateModelMode.MERGE_UPDATE, compute_dtype=dtype)
+
+    def eval_vmap_alt(st):
+        return jax.vmap(lambda m: alt_handler.evaluate(m, (xe, ye, me)))(st)
+
+    def train_slot_alt(st):
+        keys = jax.random.split(jax.random.PRNGKey(1), n_nodes)
+        return jax.vmap(alt_handler.update)(st, (xtr, ytr, mtr), keys)
+
     res = {
         "eval_vmap_ms": round(_timed(eval_vmap, eval_states,
                                      reps=args.reps), 3),
+        "eval_vmap_alt_ms": round(_timed(eval_vmap_alt, eval_states,
+                                         reps=args.reps), 3),
+        "train_slot_alt_ms": round(_timed(train_slot_alt, states,
+                                          reps=args.reps), 3),
         "eval_map_ms": round(_timed(eval_map, eval_states,
                                     reps=args.reps), 3),
         "eval_single_x_nodes_ms": round(
@@ -187,6 +226,7 @@ def main() -> None:
         "n_nodes": n_nodes, "n_eval_nodes": n_eval_nodes,
         "eval_set": e_sz, "shard": shard,
         "dtype": "bfloat16" if dtype is not None else "float32",
+        "alt_conv_impl": alt_impl,
         "components": res,
         "note": "eval_vmap is the engine's path; eval_single x nodes is the "
                 "conv floor; mfu row context: 261 ms/round full program",

@@ -14,6 +14,12 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT=BENCH_TPU_EVIDENCE.jsonl
+# Disable bench.py's internal probe-retry loop for the WHOLE script —
+# including the --print-deadline queries, so the derived outer timeouts
+# don't carry an unused poll budget. This script's outer loop
+# (poll_and_capture_evidence.sh) already polls; a mid-list wedge should
+# degrade fast and let the next attempt retry.
+export GOSSIPY_TPU_BENCH_PROBE_POLL=0
 # One log dir per attempt: the poll loop reruns this script on every
 # successful probe, and a plain truncating redirect would destroy attempt
 # N's traceback the moment attempt N+1 starts.
@@ -27,14 +33,16 @@ echo "# $(date -Is) tpu evidence run (logs: $LOGDIR)" >> "$OUT"
 # deadline + CPU-fallback headroom (1200s), so the two can never drift.
 # run_script <tag> <timeout_s> <cmd...>: the one place the invocation
 # policy lives — timestamp header, traceback filtering off, full stderr to
-# $LOGDIR/<tag>.err (last lines echoed), last stdout line appended to $OUT.
+# $LOGDIR/<tag>.err (streamed live via tee), last stdout line appended to
+# $OUT.
 run_script() {
     local tag=$1 t=$2
     shift 2
     echo "=== $(date -Is) $* (timeout ${t}s)" >&2
+    # tee keeps the full traceback on disk AND streams progress live — a
+    # 27-minute mode inside a short tunnel window must stay observable.
     JAX_TRACEBACK_FILTERING=off timeout -k 60 "$t" "$@" \
-        2> "$LOGDIR/$tag.err" | tail -1 | tee -a "$OUT"
-    tail -3 "$LOGDIR/$tag.err" >&2
+        2> >(tee "$LOGDIR/$tag.err" >&2) | tail -1 | tee -a "$OUT"
 }
 run_mode() {  # run_mode [bench args...]
     local d
@@ -43,14 +51,20 @@ run_mode() {  # run_mode [bench args...]
         $((d + 1350)) python bench.py "$@"
 }
 # --- still missing a genuine TPU row, cheapest first ---
+# Round-4 MFU attack rows FIRST: bench_mfu's config changed in round 4
+# (eval amortized via eval_every=5 + the einsum conv impl on TPU), so these
+# are NEW measurements, not reruns — the r3 row (0.0039, eval_every=1,
+# grouped-conv lowering) is a different program and any delta vs it is the
+# round-4 work, not run-to-run variance.
+run_mode --mfu 50
+run_mode --mfu-all2all 50          # the one-einsum-merge MFU upper end
 run_mode --ring-attn 8192          # flash kernel vs XLA dense attention
-# Phase attribution for the MFU attack (VERDICT #2); rows are self-labeled.
+# Phase attribution for the MFU attack (VERDICT #1); rows are self-labeled.
 run_script profile_northstar 2400 python scripts/profile_round.py
 run_script profile_cnn 2400 python scripts/profile_round.py --cnn
-# Component attribution for the 261 ms/round MFU row (eval vmap-vs-map,
+# Component attribution for the r3 261 ms/round MFU row (eval vmap-vs-map,
 # merge/train slots, snapshot) — ~1 min of device time after compiles.
 run_script microbench 2400 python scripts/microbench_components.py
-run_mode --mfu-all2all 50          # the one-einsum-merge MFU upper end
 run_mode --fused-regime            # two full CNN-clique compiles
 run_mode --scale-all2all 50000
 # The --scale modes crashed on-TPU in the 10:14 window (rc=1 at 27 min /
@@ -59,7 +73,6 @@ run_mode --scale-all2all 50000
 # kept this time.
 run_mode --scale 50000
 run_mode --scale 100000
-# --- second samples of the rows already captured 2026-07-31 10:14-10:45 ---
+# --- second sample of a row already captured 2026-07-31 10:14-10:45 ---
 run_mode                           # north-star (720.32 r/s captured)
-run_mode --mfu 50                  # 0.0039 captured
 echo "done; rows appended to $OUT" >&2

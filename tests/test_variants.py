@@ -87,6 +87,41 @@ class TestTokenized:
         balances = np.asarray(st.aux["balance"])
         assert (balances >= 0).all()
 
+    def test_reaction_utility_uses_sent_time_snapshot(self, key):
+        """The reaction utility must see the SENT-time sender snapshot (the
+        message payload), not the sender's current-round model — the
+        reference computes utility on the received handler
+        (simul.py:631-648). Distinguishable only with a snapshot-sensitive
+        utility under delay: the sent-round history cell carries age-5
+        models, the current round's cell age-0."""
+        from gossipy_tpu.core import MessageType
+        from gossipy_tpu.flow_control import PurelyReactiveTokenAccount
+        data, d = make_parts()
+        n = 16
+        sim = TokenizedGossipSimulator(
+            sgd_handler(d), Topology.clique(n), data, delta=10,
+            delay=UniformDelay(0, 30),
+            token_account=PurelyReactiveTokenAccount(k=1),
+            utility_fun=lambda m, peer: peer.n_updates.astype(jnp.float32))
+        st = sim.init_nodes(key)
+        D = st.history_ages.shape[0]
+        assert D > 3, "delay model must give distinct cells for rounds 0, 2"
+        ages = st.history_ages.at[0].set(5).at[2].set(0)
+        aux = dict(st.aux)
+        aux["balance"] = jnp.full((n,), 10, jnp.int32)
+        st = st._replace(history_ages=ages, aux=aux)
+        zeros = jnp.zeros((n,), jnp.int32)
+        out = sim._post_receive_slot(
+            st, jnp.ones((n,), bool),
+            jnp.full((n,), int(MessageType.PUSH), jnp.int32),
+            zeros,          # sender = node 0
+            zeros,          # send_round = 0 (delayed delivery at r=2)
+            zeros, key, jnp.int32(2), jnp.int32(0))
+        # Sent-time age 5 -> utility 5 -> reactions fire (capped); reading
+        # the current cell (age 0) would yield zero reactions.
+        per_node = np.asarray(out.aux["pending_reactions"])
+        assert (per_node == sim.max_reactions).all()
+
     def test_randomized_account_runs(self, key):
         data, d = make_parts()
         sim = TokenizedGossipSimulator(
@@ -245,6 +280,41 @@ class TestPENS:
         # Phase bookkeeping happened.
         assert np.asarray(st.aux["selected"]).sum() > 0
         assert np.asarray(st.aux["neigh_counter"]).sum() > 0
+
+    def test_aux_state_is_degree_bounded(self, key):
+        """PENS selection state is [N, max_deg], not [N, N] (the last dense
+        N^2 object in the codebase — VERDICT r3 #6)."""
+        data, d = make_parts(n_nodes=16)
+        sim = PENSGossipSimulator(
+            sgd_handler(d, mode=CreateModelMode.MERGE_UPDATE),
+            Topology.random_regular(16, 4), data, delta=10,
+            n_sampled=3, m_top=1, step1_rounds=4)
+        st = sim.init_nodes(key)
+        for k in ("selected", "neigh_counter", "best"):
+            assert st.aux[k].shape == (16, sim.max_deg)
+        assert sim.max_deg == 4
+
+    @pytest.mark.slow
+    def test_pens_runs_at_10k_nodes(self, key):
+        """The VERDICT r3 #6 'done' bar: PENS at 10k nodes on one device.
+        Degree-bounded aux makes the footprint O(N * max_deg); two phase-1
+        rounds + the phase switch + one phase-2 round must execute."""
+        from gossipy_tpu.core import SparseTopology
+        n = 10_000
+        rng = np.random.default_rng(0)
+        X, y = make_dataset(n=4 * n, d=8, seed=0)
+        dh = ClassificationDataHandler(X, y, test_size=0.1, seed=1)
+        disp = DataDispatcher(dh, n=n, eval_on_user=False)
+        topo = SparseTopology.random_regular(n, 8, seed=3)
+        sim = PENSGossipSimulator(
+            sgd_handler(8, mode=CreateModelMode.MERGE_UPDATE),
+            topo, disp.stacked(), delta=10, sampling_eval=0.01,
+            n_sampled=3, m_top=1, step1_rounds=2)
+        st = sim.init_nodes(key)
+        assert st.aux["selected"].shape == (n, sim.max_deg)
+        st, rep = sim.start(st, n_rounds=3)
+        assert np.isfinite(rep.curves(local=False)["accuracy"][-1])
+        assert np.asarray(st.aux["selected"]).sum() > 0
 
     def test_continuation_resumes_phase(self, key):
         # Regression: a second start() must not re-enter phase 1.
