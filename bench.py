@@ -301,10 +301,11 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
 
     rng = np.random.default_rng(0)
     # The CPU fallback cannot finish the full CNN/100-node workload in
-    # reasonable time (hours on this 1-core host; ~27 s per warm 8-node
-    # round, bf16 emulated); shrink it and compute in fp32 — the run is
-    # labeled degraded and MFU is null off-TPU anyway (unknown device kind),
-    # so only the smoke value (finite ms/round) matters.
+    # reasonable time on this 1-core host (~2.1 s per warm 8-node round in
+    # fp32 since the einsum-conv default — was ~27 s under the grouped-conv
+    # lowering); shrink it and compute in fp32 — the run is labeled
+    # degraded and MFU is null off-TPU anyway (unknown device kind), so
+    # only the smoke value (finite ms/round) matters.
     if n_nodes is None:
         n_nodes = 8 if DEGRADED else N_NODES
     if n_train is None:
@@ -637,9 +638,30 @@ def bench_ring_attention(s_len: int = 8192) -> None:
                 lambda q, k, v: flash_attention(q, k, v, causal=True))
         except Exception as e:  # kernel unavailable on this backend
             err = repr(e)[:200]
+    parity = None
+    if flash_ms is not None:
+        # On-silicon fwd+bwd parity for the hand-derived custom vjp
+        # (VERDICT r3 #3): interpreter-mode tests cannot catch a Mosaic
+        # compilation/layout bug, and the kernel is the DEFAULT TPU path of
+        # ring_attention — assert values AND gradients against XLA dense at
+        # f32, in the same JSON row the evidence file banks. Guarded: a
+        # bwd-kernel compile failure (first-ever Mosaic build of the vjp
+        # happens HERE) must land as parity.error in the row, not crash
+        # away the timings already measured.
+        try:
+            parity = _attention_parity(
+                dense, lambda q_, k_, v_: flash_attention(q_, k_, v_,
+                                                          causal=True),
+                q, k, v)
+        except Exception as e:
+            parity = {"pass": False, "error": repr(e)[:300]}
     print(f"[ring-attn] S={s_len}: dense {dense_ms:.2f} ms, flash "
           f"{flash_ms if flash_ms is None else round(flash_ms, 2)} ms"
-          + (f" (error: {err})" if err else ""), file=sys.stderr)
+          + (f" (error: {err})" if err else "")
+          + (f"; parity {'PASS' if parity['pass'] else 'FAIL'} "
+             f"({parity.get('error') or 'fwd %.2e, grad %.2e' % (parity['fwd_max_abs_err'], parity['grad_max_abs_err'])})"
+             if parity else ""),
+          file=sys.stderr)
     speedup = (dense_ms / flash_ms) if flash_ms else None
     emit({
         "metric": "flash_attention_speedup",
@@ -652,11 +674,59 @@ def bench_ring_attention(s_len: int = 8192) -> None:
             "dense_ms": round(dense_ms, 3),
             "flash_ms": (round(flash_ms, 3)
                          if flash_ms is not None else None),
+            "parity": parity,
             "error": err,
             "note": "single chip, one head; the sequence-parallel form is "
                     "collectives.ring_attention(flash=True)",
         },
     })
+
+
+def _attention_parity(dense_fn, flash_fn, q, k, v,
+                      tol: float = 5e-3) -> dict:
+    """Forward + gradient agreement of two attention implementations at
+    f32, as JSON-ready floats. ``pass`` uses an absolute tolerance scaled
+    to unit-variance inputs (softmax reduction-order differences at long
+    sequence lengths stay ~1e-5; 5e-3 flags real kernel bugs, not fp
+    noise)."""
+    import jax
+    import jax.numpy as jnp
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+    def fwd_f32(fn):
+        return fn(qf, kf, vf).astype(jnp.float32)
+
+    o_d, o_f = fwd_f32(dense_fn), fwd_f32(flash_fn)
+    fwd_err = float(jnp.max(jnp.abs(o_d - o_f)))
+
+    def loss(fn):
+        return lambda args: (fn(*args).astype(jnp.float32) ** 2).mean()
+
+    g_d = jax.grad(loss(dense_fn))((qf, kf, vf))
+    g_f = jax.grad(loss(flash_fn))((qf, kf, vf))
+    grad_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(g_d, g_f))
+    # Gradients of a mean-loss shrink with size; compare relative to their
+    # own scale so "both tiny" cannot mask a broken vjp, with a small
+    # absolute floor for the degenerate all-zero case.
+    g_scale = max(float(jnp.max(jnp.abs(g))) for g in g_d)
+    import math
+
+    def finite(x):
+        # json.dumps would emit a bare (RFC-8259-invalid) NaN/Infinity
+        # token and strict parsers would reject the whole evidence line —
+        # exactly when a broken kernel makes the row matter most.
+        return x if math.isfinite(x) else str(x)
+
+    return {
+        "fwd_max_abs_err": finite(fwd_err),
+        "grad_max_abs_err": finite(grad_err),
+        "grad_scale": finite(g_scale),
+        # Non-finite errors are a hard fail (comparisons with nan are
+        # False, so the boolean below already lands False — made explicit).
+        "pass": bool(math.isfinite(fwd_err) and math.isfinite(grad_err)
+                     and fwd_err < tol
+                     and grad_err < max(2 * tol * g_scale, 1e-7)),
+    }
 
 
 def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:

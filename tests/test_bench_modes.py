@@ -189,6 +189,42 @@ def test_watchdog_degrades_on_wedged_accel_run():
     assert row["value"] > 0
 
 
+def test_attention_parity_helper(bench):
+    """_attention_parity (the on-chip fwd+bwd parity row for --ring-attn)
+    must pass for identical implementations and fail for a subtly wrong
+    one — exercised off-TPU so the first on-chip run cannot be its first
+    run ever."""
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.PRNGKey(0)
+    s, d = 64, 16
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (s, d))
+               for i in range(3))
+
+    def dense(q_, k_, v_):
+        sc = (q_ @ k_.T) / np.sqrt(d)
+        i = jnp.arange(s)
+        sc = jnp.where(i[None, :] > i[:, None], -1e30, sc)
+        return jax.nn.softmax(sc, axis=-1) @ v_
+
+    good = bench._attention_parity(dense, dense, q, k, v)
+    assert good["pass"] and good["fwd_max_abs_err"] == 0.0
+
+    def broken(q_, k_, v_):  # wrong scale: the classic kernel bug shape
+        return dense(q_, k_, v_) * 1.05
+
+    bad = bench._attention_parity(dense, broken, q, k, v)
+    assert not bad["pass"] and bad["fwd_max_abs_err"] > 1e-3
+
+    def nan_kernel(q_, k_, v_):  # NaN output: must fail AND stay strict JSON
+        return dense(q_, k_, v_) * jnp.nan
+
+    import json
+    nan_row = bench._attention_parity(dense, nan_kernel, q, k, v)
+    assert nan_row["pass"] is False
+    json.loads(json.dumps(nan_row, allow_nan=False))  # RFC-8259-strict
+
+
 def test_backend_poll_before_degrade(bench, monkeypatch):
     """VERDICT r3 #4: the watchdog polls the probe before degrading so the
     driver-visible row is a TPU row whenever a window opens mid-run.
