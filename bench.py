@@ -462,15 +462,29 @@ def _scale_harness(n_nodes: int, rounds: int, build_sim):
     disp = DataDispatcher(                   # runs keep a 20% split
         ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
         n=n_nodes, eval_on_user=False)
+
+    def stamp(phase):
+        # Forensics for the round-3 on-TPU crash (rc=1 at ~27 min with the
+        # traceback lost): phase-stamped progress makes the crash point
+        # attributable from evidence_logs/<tag>.err alone, even if the
+        # process dies without a traceback again.
+        print(f"[scale] {time.strftime('%H:%M:%S')} {phase}",
+              file=sys.stderr, flush=True)
+
+    stamp("building topology+simulator")
     sim, build_s = build_sim(d, disp)
     key = jax.random.PRNGKey(42)
+    stamp("init_nodes")
     state = sim.init_nodes(key)
+    stamp(f"compile+first {rounds}-round run")
     s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
     jax.block_until_ready(s2.model.params)
+    stamp("timed run")
     t0 = time.perf_counter()
     s3, report = sim.start(state, n_rounds=rounds, key=key)
     jax.block_until_ready(s3.model.params)
     elapsed = time.perf_counter() - t0
+    stamp("done")
     acc = report.curves(local=False)["accuracy"][-1]
     return rounds / elapsed, float(acc), build_s
 
@@ -659,7 +673,7 @@ def bench_ring_attention(s_len: int = 8192) -> None:
           f"{flash_ms if flash_ms is None else round(flash_ms, 2)} ms"
           + (f" (error: {err})" if err else "")
           + (f"; parity {'PASS' if parity['pass'] else 'FAIL'} "
-             f"({parity.get('error') or 'fwd %.2e, grad %.2e' % (parity['fwd_max_abs_err'], parity['grad_max_abs_err'])})"
+             f"({parity.get('error') or _parity_desc(parity)})"
              if parity else ""),
           file=sys.stderr)
     speedup = (dense_ms / flash_ms) if flash_ms else None
@@ -680,6 +694,15 @@ def bench_ring_attention(s_len: int = 8192) -> None:
                     "collectives.ring_attention(flash=True)",
         },
     })
+
+
+def _parity_desc(parity: dict) -> str:
+    """Human line for the parity dict; non-finite errors arrive as STRINGS
+    (json sanitization), so no %.2e on them."""
+    def fmt(v):
+        return f"{v:.2e}" if isinstance(v, float) else str(v)
+    return (f"fwd {fmt(parity['fwd_max_abs_err'])}, "
+            f"grad {fmt(parity['grad_max_abs_err'])}")
 
 
 def _attention_parity(dense_fn, flash_fn, q, k, v,

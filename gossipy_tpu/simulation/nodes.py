@@ -445,6 +445,58 @@ class PENSGossipSimulator(GossipSimulator):
         return state, merged
 
 
+    def run_repetitions(self, n_rounds: int, keys, local_train: bool = True,
+                        common_init: bool = False):
+        """Phase-aware multi-seed runs (the base implementation scans all
+        ``n_rounds`` in one program, which would never leave phase 1).
+
+        Segment 1 reuses the base vmapped init+scan (``_cache_salt`` keys
+        the jit cache by phase); the phase switch (``_select_neighbors``)
+        broadcasts over the seed axis since it is a pure per-node function;
+        segment 2 continues the stacked states under the phase-2 trace.
+        """
+        assert not self._receivers_list(), \
+            "run_repetitions does not support event receivers; use start()"
+        r1 = max(0, min(self.step1_rounds, n_rounds))
+        r2 = n_rounds - r1
+        if r2 <= 0:
+            self._step = 1
+            return super().run_repetitions(n_rounds, keys, local_train,
+                                           common_init)
+        self._step = 1
+        states, reports1 = super().run_repetitions(r1, keys, local_train,
+                                                   common_init)
+        states = jax.vmap(self._select_neighbors)(states)
+        self._step = 2
+        cache_k = ("reps_cont", r2, self._cache_salt())
+        if cache_k not in self._jit_cache:
+            def cont(state, key):
+                k_run = jax.random.fold_in(jax.random.split(key)[1], 2)
+                last = state.round + r2 - 1
+
+                def body(s, _):
+                    return self._round(s, k_run, last)
+
+                return jax.lax.scan(body, state, None, length=r2)
+            self._jit_cache[cache_k] = jax.jit(jax.vmap(cont))
+        states, stats2 = self._jit_cache[cache_k](states, keys)
+        host2 = jax.tree.map(np.asarray, stats2)
+        reports = []
+        for i, rep1 in enumerate(reports1):
+            rep2 = self._build_report(jax.tree.map(lambda a, i=i: a[i], host2))
+            reports.append(SimulationReport(
+                metric_names=rep1.metric_names,
+                local_evals=_cat([rep1._local, rep2._local]),
+                global_evals=_cat([rep1._global, rep2._global]),
+                sent=np.concatenate([rep1.sent_per_round,
+                                     rep2.sent_per_round]),
+                failed=np.concatenate([rep1.failed_per_round,
+                                       rep2.failed_per_round]),
+                total_size=rep1.total_size + rep2.total_size,
+            ))
+        return states, reports
+
+
 def _cat(arrs):
     arrs = [a for a in arrs if a is not None]
     return np.concatenate(arrs) if arrs else None

@@ -223,6 +223,9 @@ def test_attention_parity_helper(bench):
     nan_row = bench._attention_parity(dense, nan_kernel, q, k, v)
     assert nan_row["pass"] is False
     json.loads(json.dumps(nan_row, allow_nan=False))  # RFC-8259-strict
+    # The stderr line must also survive string-typed (sanitized) errors.
+    assert "nan" in bench._parity_desc(nan_row)
+    assert "e" in bench._parity_desc(good)  # floats format as %.2e
 
 
 def test_backend_poll_before_degrade(bench, monkeypatch):

@@ -108,24 +108,22 @@ def run_reference_pens_curves(X, y) -> list:
 
 
 def run_ours_pens_curves(X, y) -> list:
-    curves = []
-    for seed in range(N_SEEDS):
-        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=seed)
-        disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
-        handler = SGDHandler(
-            model=LogisticRegression(D, 2), loss=losses.cross_entropy,
-            optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8,
-            n_classes=2, input_shape=(D,),
-            create_model_mode=CreateModelMode.MERGE_UPDATE)
-        sim = PENSGossipSimulator(
-            handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
-            protocol=AntiEntropyProtocol.PUSH, n_sampled=4, m_top=2,
-            step1_rounds=PENS_STEP1)
-        key = jax.random.PRNGKey(seed)
-        st = sim.init_nodes(key)
-        st, report = sim.start(st, n_rounds=PENS_ROUNDS, key=key)
-        curves.append(report.curves(local=False)["accuracy"])
-    return curves
+    """All S seeds via the phase-aware run_repetitions — one compiled
+    program per phase instead of S sequential two-phase starts."""
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=0)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(
+        model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8,
+        n_classes=2, input_shape=(D,),
+        create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = PENSGossipSimulator(
+        handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
+        protocol=AntiEntropyProtocol.PUSH, n_sampled=4, m_top=2,
+        step1_rounds=PENS_STEP1)
+    keys = jax.random.split(jax.random.PRNGKey(7), N_SEEDS)
+    _, reports = sim.run_repetitions(PENS_ROUNDS, keys)
+    return [r.curves(local=False)["accuracy"] for r in reports]
 
 
 def run_reference_tokenized_curves(X, y) -> list:

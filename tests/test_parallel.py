@@ -113,6 +113,48 @@ def test_sharded_run_matches_unsharded(key):
     assert rep_plain.sent_messages == rep_sh.sent_messages
 
 
+def test_pens_sharded_run_matches_unsharded(key):
+    """PENS's round-4 degree-bounded aux ([N, max_deg] counters + [N, S]
+    model cache) must shard over the node axis like every other leaf and
+    reproduce the unsharded two-phase run exactly."""
+    from gossipy_tpu.core import CreateModelMode
+    from gossipy_tpu.simulation import PENSGossipSimulator
+
+    def build_pens(data=None):
+        n_nodes, d = 16, 6
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=d)
+        X = rng.normal(size=(n_nodes * 12, d)).astype(np.float32)
+        y = (X @ w > 0).astype(np.int64)
+        disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.25),
+                              n=n_nodes)
+        handler = SGDHandler(model=MLP(d, 2, hidden_dims=(8,)),
+                             loss=losses.cross_entropy,
+                             optimizer=optax.sgd(0.2), local_epochs=1,
+                             batch_size=4, n_classes=2, input_shape=(d,),
+                             create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = PENSGossipSimulator(
+            handler, Topology.clique(n_nodes),
+            disp.stacked() if data is None else data, delta=10,
+            n_sampled=4, m_top=2, step1_rounds=3)
+        return sim, disp
+
+    sim, disp = build_pens()
+    st = sim.init_nodes(key)
+    _, rep_plain = sim.start(st, n_rounds=5, key=jax.random.fold_in(key, 1))
+
+    mesh = make_mesh(8)
+    sim_sh, _ = build_pens(data=shard_data(disp.stacked(), mesh))
+    st_sh = shard_state(sim_sh.init_nodes(key), mesh)
+    assert st_sh.aux["selected"].sharding.spec[0] == "nodes"
+    _, rep_sh = sim_sh.start(st_sh, n_rounds=5,
+                             key=jax.random.fold_in(key, 1))
+
+    np.testing.assert_allclose(rep_plain.curves(local=False)["accuracy"],
+                               rep_sh.curves(local=False)["accuracy"],
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_state_shardings_structure(key):
     sim, _ = build()
     st = sim.init_nodes(key)

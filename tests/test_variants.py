@@ -316,6 +316,24 @@ class TestPENS:
         assert np.isfinite(rep.curves(local=False)["accuracy"][-1])
         assert np.asarray(st.aux["selected"]).sum() > 0
 
+    def test_run_repetitions_crosses_the_phase_switch(self, key):
+        """PENS's multi-seed path must run BOTH phases (the base
+        run_repetitions would scan every round under phase 1): full-length
+        curves per seed, phase-2 'best' selections populated, and the
+        network learns in every repetition."""
+        data, d = make_parts()
+        sim = PENSGossipSimulator(
+            sgd_handler(d, mode=CreateModelMode.MERGE_UPDATE),
+            Topology.clique(16), data, delta=10,
+            n_sampled=4, m_top=2, step1_rounds=3)
+        states, reports = sim.run_repetitions(8, jax.random.split(key, 3))
+        assert len(reports) == 3
+        for rep in reports:
+            acc = rep.curves(local=False)["accuracy"]
+            assert len(acc) == 8 and acc[-1] > 0.7
+        # The stacked final states carry phase-2 selections per seed.
+        assert np.asarray(states.aux["best"]).reshape(3, -1).any(axis=1).all()
+
     def test_continuation_resumes_phase(self, key):
         # Regression: a second start() must not re-enter phase 1.
         data, d = make_parts(n_nodes=8)
