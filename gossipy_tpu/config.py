@@ -104,8 +104,9 @@ def _model(name: str, params: dict, input_dim: int, n_classes: int):
         only("out_dim")
         return models.LinearRegression(input_dim, params.get("out_dim", 1))
     if name == "cifar10net":
-        no_params()
-        return models.CIFAR10Net()
+        only("conv_impl")
+        return models.CIFAR10Net(
+            conv_impl=params.get("conv_impl", "auto"))
     raise ValueError(f"unknown model {name!r}; options: logreg, mlp, "
                      f"perceptron, linreg, cifar10net")
 

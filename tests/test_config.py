@@ -140,6 +140,16 @@ class TestRun:
             state, report = run_experiment(cfg)
         assert np.isfinite(report.curves(local=False)["accuracy"][-1])
 
+    def test_cnn_conv_impl_is_configurable(self):
+        """The CNN's conv lowering is a config knob (strictly validated):
+        experiments can pin conv_impl in JSON; typos still raise."""
+        from gossipy_tpu.config import _model
+        m = _model("cifar10net", {"conv_impl": "conv"}, 32, 10)
+        assert m.conv_impl == "conv"
+        assert _model("cifar10net", {}, 32, 10).conv_impl == "auto"
+        with pytest.raises(ValueError, match="unknown model_params"):
+            _model("cifar10net", {"oops": 1}, 32, 10)
+
     def test_shipped_configs_parse_and_validate(self):
         import glob
         import os
