@@ -473,6 +473,10 @@ def _scale_harness(n_nodes: int, rounds: int, build_sim):
 
     stamp("building topology+simulator")
     sim, build_s = build_sim(d, disp)
+    budget = sim.memory_budget()
+    stamp("memory budget: " + ", ".join(
+        f"{k}={v / 2**20:.1f}MB" for k, v in budget.items()
+        if k.endswith("_bytes") and v is not None))
     key = jax.random.PRNGKey(42)
     stamp("init_nodes")
     state = sim.init_nodes(key)
@@ -918,7 +922,7 @@ def _deadline_override(default: float) -> float:
         return default
 
 
-def _run_with_watchdog(default_deadline: float = 1500.0) -> None:
+def _run_with_watchdog(deadline: float = 1500.0) -> None:
     """Run the accelerator attempt in a deadline-guarded child.
 
     A live probe does not guarantee a live run: the tunneled runtime has
@@ -938,7 +942,7 @@ def _run_with_watchdog(default_deadline: float = 1500.0) -> None:
     is labeled with that rc in the row (``raw.degrade_reason``) so a
     deterministic bench/engine crash stays distinguishable from a tunnel
     outage (the child's traceback also passes through on stderr).
-    The default deadline is mode-aware (``default_deadline``): the driver's
+    The deadline is mode-aware (resolved by the caller): the driver's
     north-star run gets 1500 s (measured healthy time ≈ 3-4 min including a
     cold compile), while big ``--scale N`` rows grow with N — the repo's own
     records put 500k nodes at 0.10 r/s, i.e. ~2000 s of legitimate runtime
@@ -950,7 +954,9 @@ def _run_with_watchdog(default_deadline: float = 1500.0) -> None:
     """
     import subprocess
     import threading
-    deadline = _deadline_override(default_deadline)
+    # ``deadline`` arrives already resolved through _deadline_override in
+    # main() — re-applying it here would print the malformed-env warning
+    # twice (round-4 advisor).
     import signal
     env = dict(os.environ, PYTHONUNBUFFERED="1")
     # Own session: if THIS process is killed externally (e.g. the evidence

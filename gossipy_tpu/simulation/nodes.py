@@ -46,6 +46,20 @@ def build_neighbor_table(topology) -> np.ndarray:
         i, j = np.nonzero(np.asarray(topology.adjacency))
         pos = np.arange(len(i)) - np.searchsorted(i, i, side="left")
         nbr_table[i, pos] = j
+    # Slot-keyed counters (PENS hit counts, CacheNeigh model slots) assume
+    # each peer occupies exactly ONE slot of its receiver's row; a
+    # multigraph row would double-count matches (round-4 advisor). Dense
+    # adjacencies cannot express duplicates (np.nonzero yields unique
+    # pairs); CSR rows can, so reject them up front.
+    if isinstance(topology, SparseTopology) and n:
+        row_sorted = np.sort(nbr_table, axis=1)
+        dup = (row_sorted[:, 1:] >= 0) & (row_sorted[:, 1:] == row_sorted[:, :-1])
+        if dup.any():
+            bad = int(np.nonzero(dup.any(axis=1))[0][0])
+            raise ValueError(
+                f"topology row {bad} lists a neighbor more than once; "
+                "slot-keyed variant state (PENS/CacheNeigh) requires "
+                "duplicate-free neighbor lists — deduplicate the edge list")
     return nbr_table
 
 
@@ -454,6 +468,15 @@ class PENSGossipSimulator(GossipSimulator):
         the jit cache by phase); the phase switch (``_select_neighbors``)
         broadcasts over the seed axis since it is a pure per-node function;
         segment 2 continues the stacked states under the phase-2 trace.
+
+        Note: like :meth:`start`, the two-segment split treats round
+        ``step1_rounds - 1`` as a segment-final round, which under
+        ``eval_every > 1`` forces an evaluation at the phase boundary that
+        one continuous ``n_rounds`` scan would skip — report rows can
+        differ by that one extra eval row between the two code paths
+        (round-4 advisor: accepted, the boundary eval is a feature — the
+        phase-1 endpoint is exactly the curve point PENS studies care
+        about).
         """
         assert not self._receivers_list(), \
             "run_repetitions does not support event receivers; use start()"

@@ -1,0 +1,121 @@
+"""Compacted deliver-phase equivalence (engine.compact_deliver).
+
+The compacted slot pass gathers each mailbox slot's live receivers into a
+static small batch before the merge+train pass instead of running the pass
+full-width under a mask (the round-4 verdict's #1 MFU lever: at Poisson(~1)
+fan-in the masked passes waste ~3/4 of the deliver-phase FLOPs). These
+tests pin the contract: trajectories are IDENTICAL with compaction on or
+off — including when the static capacity overflows at runtime and the
+engine falls back to the full-width pass mid-scan — because per-node PRNG
+streams are preserved and overflow dispatch is a ``lax.cond``.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+    Topology, UniformDelay
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, SamplingSGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import GossipSimulator, \
+    PassThroughGossipSimulator, SamplingGossipSimulator
+
+
+def make_sim(compact, n_nodes=16, protocol=AntiEntropyProtocol.PUSH,
+             sim_cls=GossipSimulator, handler_cls=SGDHandler, **sim_kwargs):
+    rng = np.random.default_rng(3)
+    d = 10
+    w = rng.normal(size=d)
+    X = rng.normal(size=(320, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n_nodes)
+    kw = {}
+    if handler_cls is SamplingSGDHandler:
+        kw["sample_size"] = 0.5
+    handler = handler_cls(model=LogisticRegression(d, 2),
+                          loss=losses.cross_entropy,
+                          optimizer=optax.sgd(0.1), local_epochs=1,
+                          batch_size=16, n_classes=2, input_shape=(d,),
+                          create_model_mode=CreateModelMode.MERGE_UPDATE,
+                          **kw)
+    return sim_cls(handler, Topology.random_regular(n_nodes, 6, seed=7),
+                   disp.stacked(), delta=20, protocol=protocol,
+                   compact_deliver=compact, **sim_kwargs)
+
+
+def run(sim, key, rounds=6):
+    st = sim.init_nodes(key)
+    st, report = sim.start(st, n_rounds=rounds, key=jax.random.fold_in(key, 1))
+    return st, report
+
+
+def assert_same_trajectory(key, rounds=6, **kwargs):
+    cap = kwargs.pop("cap", 4)
+    s_off, r_off = run(make_sim(False, **kwargs), key, rounds)
+    s_on, r_on = run(make_sim(cap, **kwargs), key, rounds)
+    for a, b in zip(jax.tree_util.tree_leaves(s_off.model.params),
+                    jax.tree_util.tree_leaves(s_on.model.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=1e-6)
+    assert r_off.sent_messages == r_on.sent_messages
+    assert r_off.failed_messages == r_on.failed_messages
+    co = r_off.curves(local=False)["accuracy"]
+    cn = r_on.curves(local=False)["accuracy"]
+    np.testing.assert_allclose(co, cn, atol=1e-6)
+
+
+class TestCompactEquivalence:
+    def test_small_cap_overflow_falls_back(self, key):
+        # cap=2 on a 16-node population: slot 0 overflows the capacity
+        # nearly every round (the full-width cond branch runs), higher
+        # slots fit (the compact branch runs) — both paths are exercised
+        # and the trajectory must not budge.
+        assert_same_trajectory(key, cap=2)
+
+    def test_full_cap_never_overflows(self, key):
+        assert_same_trajectory(key, cap=16)
+
+    def test_with_faults_and_delay(self, key):
+        assert_same_trajectory(key, cap=6, drop_prob=0.2, online_prob=0.8,
+                               delay=UniformDelay(0, 35))
+
+    def test_push_pull_replies(self, key):
+        # Replies route through _receive_slot_apply too (reply phase);
+        # PUSH_PULL exercises both mailboxes under compaction.
+        assert_same_trajectory(key, cap=4,
+                               protocol=AntiEntropyProtocol.PUSH_PULL)
+
+    def test_decode_extra_variant(self, key):
+        # SamplingGossipSimulator overrides _decode_extra (per-message
+        # sample seeds) but not _apply_receive: the decoded arg must be
+        # gathered per compacted row, preserving each receiver's mask.
+        assert_same_trajectory(key, cap=5, sim_cls=SamplingGossipSimulator,
+                               handler_cls=SamplingSGDHandler)
+
+
+class TestCompactGating:
+    def test_auto_off_below_population_floor(self, key):
+        assert make_sim(None)._compact_cap is None  # 16 < 48
+
+    def test_explicit_cap_clamped_to_population(self, key):
+        assert make_sim(64)._compact_cap == 16
+
+    def test_variant_override_rejected(self, key):
+        with pytest.raises(AssertionError, match="base _apply_receive"):
+            make_sim(True, sim_cls=PassThroughGossipSimulator)
+
+    def test_variant_auto_silently_off(self, key):
+        sim = make_sim(None, sim_cls=PassThroughGossipSimulator)
+        assert sim._compact_cap is None
+
+    def test_derived_cap_at_scale(self):
+        # At 100 nodes / degree 20 / PUSH the worst-case fan-in is ~1:
+        # the derived capacity sits well under the population (the whole
+        # point) but above the mean second-arrival count.
+        sim = make_sim(True, n_nodes=100)
+        assert sim._compact_cap is not None
+        assert 24 <= sim._compact_cap < 75
