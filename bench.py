@@ -284,6 +284,17 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
 
     ``n_nodes``/``n_train``/``n_test`` override the workload size (smoke
     tests; the measured MFU is only meaningful at the default scale).
+
+    Round-5 accounting note: the vanilla sim runs with the engine's default
+    ``compact_deliver`` (auto-on at this scale — slots >= 1 run at a
+    gathered static capacity instead of full-width masked). XLA's HLO cost
+    model prices the compact/full ``lax.cond`` at its LARGER branch
+    (verified: on/off 1-round programs count within 228 FLOPs of each
+    other at the 100-node LogReg config), so the numerator stays the
+    canonical full-width program's count while compaction cuts the time —
+    the quoted fraction is throughput against the canonical workload
+    (the same definition every earlier MFU row used), not a hardware FLOP
+    counter. ``raw.compact_cap`` records the active capacity.
     """
     if variant not in ("vanilla", "all2all"):
         raise ValueError(f"unknown MFU variant {variant!r} "
@@ -417,6 +428,7 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
             "device_kind": kind,
             "protocol": variant,
             "n_nodes": n_nodes,
+            "compact_cap": getattr(sim, "_compact_cap", None),
             "eval_every": eval_every,
             "n_eval_rounds": n_evals,
             "ms_per_round": round(elapsed / rounds * 1e3, 2),

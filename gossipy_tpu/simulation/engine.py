@@ -302,6 +302,9 @@ class GossipSimulator(SimulationEventSender):
         run unfused full-width; ``_decode_extra`` overrides are fine — the
         decoded arg is gathered — provided they are elementwise, which all
         in-tree ones are). An int pins the capacity explicitly.
+        :meth:`run_repetitions` always runs its seed-vmapped program with
+        compaction off — a vmapped ``lax.cond`` predicate executes both
+        branches, which would ADD the compact pass to every wide one.
     """
 
     def __init__(self,
@@ -396,7 +399,13 @@ class GossipSimulator(SimulationEventSender):
                 "deliver paths"
         if compact_deliver and not isinstance(compact_deliver, bool):
             # Explicit integer capacity (tests / tuning); overflow still
-            # falls back to the full-width pass, so ANY value is correct.
+            # falls back to the full-width pass, so ANY positive value is
+            # correct. Reject nonsense here — a negative cap would only
+            # surface as a deep lax shape error at first trace.
+            if int(compact_deliver) < 1:
+                raise ValueError(
+                    f"compact_deliver capacity must be >= 1, got "
+                    f"{compact_deliver} (use False/None to disable)")
             self._compact_cap: Optional[int] = min(int(compact_deliver),
                                                    self.n_nodes)
         elif compact_deliver and self.K == 1:
@@ -531,6 +540,15 @@ class GossipSimulator(SimulationEventSender):
             return max(int(self.n_nodes * self.sampling_eval), 1)
         return self.n_nodes
 
+    def _eval_peak_bytes(self) -> int:
+        """Transient peak of the global-evaluation pass: scores + the
+        paired AUC-sort operands, ~3 [eval-nodes, eval-samples] f32
+        buffers. The ONE formula behind both the construction-time warning
+        and :meth:`memory_budget`, so the two cannot drift."""
+        if not self.has_global_eval:
+            return 0
+        return 3 * self._n_eval_nodes() * int(self.data["x_eval"].shape[0]) * 4
+
     def _warn_if_eval_memory_large(self) -> None:
         """Warn when the global-evaluation score tensor will be huge.
 
@@ -545,8 +563,7 @@ class GossipSimulator(SimulationEventSender):
             return
         n_eval_nodes = self._n_eval_nodes()
         n_samples = int(self.data["x_eval"].shape[0])
-        # Scores + the paired sort operands: ~3 [nodes, samples] f32 buffers.
-        est_bytes = 3 * n_eval_nodes * n_samples * 4
+        est_bytes = self._eval_peak_bytes()
         if est_bytes > 2 << 30:
             import warnings
             warnings.warn(
@@ -595,9 +612,7 @@ class GossipSimulator(SimulationEventSender):
         mailbox_b = 4 * 4 * D * n * self.K   # 4 int32 fields
         reply_b = 4 * 4 * D * n * self.Kr
         data_b = leaf_bytes(self.data)
-        n_eval_nodes = self._n_eval_nodes()
-        eval_b = (3 * n_eval_nodes * int(self.data["x_eval"].shape[0]) * 4
-                  if self.has_global_eval else 0)
+        eval_b = self._eval_peak_bytes()
         out = {
             "model_and_opt_bytes": per_node_model * n,
             "history_ring_bytes": D * n * per_node_params,
@@ -1320,7 +1335,17 @@ class GossipSimulator(SimulationEventSender):
                 return jax.lax.scan(body, st, None, length=n_rounds)
             self._jit_cache[cache_k] = jax.jit(jax.vmap(one))
 
-        states, stats = self._jit_cache[cache_k](keys)
+        # Under the seed vmap the compact/wide dispatch predicate is
+        # batched, and a lax.cond with a batched predicate executes BOTH
+        # branches — compaction would add the [cap] pass on top of every
+        # full-width pass instead of replacing it. Trace (first call) and
+        # run the repetition program with compaction off; start() keeps it.
+        saved_cap = self._compact_cap
+        self._compact_cap = None
+        try:
+            states, stats = self._jit_cache[cache_k](keys)
+        finally:
+            self._compact_cap = saved_cap
         host = jax.tree.map(np.asarray, stats)  # one device->host transfer
         n_reps = host["sent"].shape[0]
         reports = [self._build_report(jax.tree.map(lambda a, i=i: a[i], host))

@@ -97,12 +97,34 @@ class TestCompactEquivalence:
                                handler_cls=SamplingSGDHandler)
 
 
+class TestCompactRepetitions:
+    def test_run_repetitions_disables_compaction_and_matches(self, key):
+        # A vmapped cond predicate executes both branches, so the seed-
+        # batched program always traces with compaction off; a compact-
+        # configured sim must produce the same repetition curves as a
+        # plain one and leave its cap restored for start().
+        keys = jax.random.split(key, 3)
+        sim_on = make_sim(4)
+        sim_off = make_sim(False)
+        _, reps_on = sim_on.run_repetitions(5, keys)
+        _, reps_off = sim_off.run_repetitions(5, keys)
+        assert sim_on._compact_cap == 4  # restored after the vmapped run
+        for a, b in zip(reps_on, reps_off):
+            np.testing.assert_allclose(a.curves(local=False)["accuracy"],
+                                       b.curves(local=False)["accuracy"],
+                                       atol=1e-6)
+
+
 class TestCompactGating:
     def test_auto_off_below_population_floor(self, key):
         assert make_sim(None)._compact_cap is None  # 16 < 48
 
     def test_explicit_cap_clamped_to_population(self, key):
         assert make_sim(64)._compact_cap == 16
+
+    def test_negative_cap_rejected(self, key):
+        with pytest.raises(ValueError, match="capacity must be >= 1"):
+            make_sim(-2)
 
     def test_variant_override_rejected(self, key):
         with pytest.raises(AssertionError, match="base _apply_receive"):
