@@ -15,6 +15,7 @@ from .nodes import (
     SamplingGossipSimulator,
 )
 from .report import SimulationReport
+from .sequential import MessageRecord, SequentialGossipSimulator, SeqState
 from .variants import (
     All2AllGossipSimulator,
     TokenizedGossipSimulator,
@@ -30,4 +31,5 @@ __all__ = [
     "PENSGossipSimulator",
     "SimulationEventReceiver", "SimulationEventSender", "ProgressReceiver",
     "JSONLinesReceiver",
+    "SequentialGossipSimulator", "SeqState", "MessageRecord",
 ]

@@ -49,6 +49,13 @@ class SimulationEventReceiver:
         """Per-round message traffic: ``sent`` messages generated, ``failed``
         lost (drop / churn / overflow), ``size`` total scalars shipped."""
 
+    def update_single_message(self, failed: bool, msg) -> None:
+        """Per-MESSAGE event (the reference's ``update_message(failed,
+        msg)`` granularity, simul.py:55-66). Only the opt-in sequential
+        high-fidelity engine (:mod:`.sequential`) emits these — a jitted
+        round has no per-message host boundary; ``msg`` is a
+        :class:`~gossipy_tpu.simulation.sequential.MessageRecord`."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""

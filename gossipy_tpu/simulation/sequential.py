@@ -1,0 +1,407 @@
+"""Opt-in high-fidelity sequential engine for small-N verification studies.
+
+The jitted bulk-synchronous engine (:mod:`.engine`) trades three fidelity
+corners for compilability (PARITY.md divergence table):
+
+1. per-ROUND observer granularity instead of the reference's per-message
+   ``update_message`` events (reference gossipy/simul.py:37-88, notify at
+   :401-407);
+2. next-round delivery of token reactions instead of same-tick
+   (simul.py:631-648 — a zero-delay reaction lands in the queue being
+   drained and can cascade within the tick);
+3. round-start snapshots instead of in-round sequential state — the
+   reference's shuffled per-tick loop lets a node forward a model it
+   merged earlier in the same tick (simul.py:389-451).
+
+:class:`SequentialGossipSimulator` closes all three for populations small
+enough that an eager event loop is affordable (hundreds of nodes, tens of
+rounds): Python tick loop for *scheduling*, jitted single-node JAX calls
+for the *math* (the same ``handler.call`` / ``handler.update`` the bulk
+engine vmaps — one compile, reused for every event). It is a verification
+instrument, not the performance path: use it to audit the bulk engine's
+divergences on a config, then run the real study on the bulk engine.
+
+Event-order contract (mirrors the reference tick loop, simul.py:384-451):
+per tick ``t`` — (a) the send sweep over a per-round shuffled node order
+(each sender snapshots its CURRENT model, including merges earlier in the
+same tick); (b) the arrival drain for ``t`` (online check per receiver;
+``handler.call``; replies and token reactions scheduled at ``t + delay``,
+a zero delay landing back in the drain and cascading); (c) the reply
+drain; (d) at round boundaries, evaluation + per-round events. Observers
+additionally get a live ``update_single_message(failed, record)`` per
+message, the per-message granularity the bulk engine cannot emit.
+
+Documented divergences from the reference loop (deliberate, both
+reference bugs): an isolated sender skips its send instead of aborting
+the whole sweep (simul.py:398-399 ``break``), and token reactions
+originate from the RECEIVER, not whatever node the send sweep last
+touched (simul.py:640 reuses the stale loop variable; the bulk engine
+fixes the same bug).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import AntiEntropyProtocol, ConstantDelay, Delay, MessageType
+from ..flow_control import TokenAccount
+from ..handlers.base import BaseHandler, ModelState, PeerModel
+from .engine import PROTO_TO_MSG
+from .events import SimulationEventSender
+from .report import SimulationReport
+
+
+@dataclass
+class MessageRecord:
+    """Per-message observer payload (the reference's ``Message`` view:
+    core.py Message — timestamp/type/sender/receiver/size)."""
+
+    t: int
+    round: int
+    sender: int
+    receiver: int
+    msg_type: MessageType
+    size: int
+
+
+@dataclass
+class SeqState:
+    """Eager-mode simulation state: one ModelState per node."""
+
+    models: List[ModelState]
+    phase: np.ndarray                  # [N] sync offset or async period
+    balance: Optional[np.ndarray]      # [N] token balances (tokenized only)
+    round: int = 0
+
+
+@dataclass
+class _Pending:
+    """A scheduled delivery: the payload is the sender-at-send-time view."""
+
+    rec: MessageRecord
+    payload: Optional[PeerModel]       # None for PULL requests
+    is_reply: bool = False
+
+
+class SequentialGossipSimulator(SimulationEventSender):
+    """Reference-faithful sequential gossip for small N (see module doc).
+
+    Accepts the same core configuration as :class:`.engine.GossipSimulator`
+    plus the tokenized options; pass ``token_account`` to enable
+    Danner-2018 flow control with SAME-TICK reactive delivery.
+    ``utility_fun(receiver_model: ModelState, sender_snapshot: PeerModel)
+    -> float`` is the per-message utility (constant 1 default, the shipped
+    experiment's choice, reference main_hegedus_2021.py:59).
+    """
+
+    def __init__(self,
+                 handler: BaseHandler,
+                 topology,
+                 data: dict,
+                 delta: int = 100,
+                 protocol: AntiEntropyProtocol = AntiEntropyProtocol.PUSH,
+                 drop_prob: float = 0.0,
+                 online_prob: float = 1.0,
+                 delay: Delay = ConstantDelay(0),
+                 sampling_eval: float = 0.0,
+                 sync: bool = True,
+                 token_account: Optional[TokenAccount] = None,
+                 utility_fun: Optional[Callable] = None):
+        assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
+        self.handler = handler
+        self.topology = topology
+        self.n_nodes = topology.num_nodes
+        if self.n_nodes > 512:
+            import warnings
+            warnings.warn(
+                f"SequentialGossipSimulator is an eager verification mode; "
+                f"{self.n_nodes} nodes will be slow — use GossipSimulator "
+                f"for studies at this scale.")
+        self.delta = int(delta)
+        self.protocol = protocol
+        self.drop_prob = float(drop_prob)
+        self.online_prob = float(online_prob)
+        self.delay = delay
+        self.sampling_eval = float(sampling_eval)
+        self.sync = sync
+        self.account = token_account
+        self.utility_fun = utility_fun or (lambda recv, snap: 1.0)
+
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+        self.has_local_test = "xte" in data
+        self.has_global_eval = "x_eval" in data
+        # Per-node out-neighbor lists (host ints; peer sampling is host-side
+        # scheduling, like every other random draw in this engine).
+        from .nodes import build_neighbor_table
+        nbr = build_neighbor_table(topology)
+        self._nbrs = [row[row >= 0] for row in nbr]
+        if hasattr(handler, "get_size"):
+            self._size = int(handler.get_size())
+        else:
+            # Parameter-count fallback, the bulk engine's _model_size rule
+            # (reference Sizeable accounting, gossipy/__init__.py:134-156).
+            st = jax.eval_shape(handler.init, jax.random.PRNGKey(0))
+            self._size = sum(int(np.prod(l.shape))
+                             for l in jax.tree_util.tree_leaves(st.params))
+        # One jitted program per single-node op, reused for every event.
+        self._jit_call = jax.jit(handler.call)
+        self._jit_update = jax.jit(handler.update)
+        self._jit_eval_batch = jax.jit(jax.vmap(handler.evaluate))
+
+        def eval_global(stacked, xe, ye, me):
+            return jax.vmap(lambda m: handler.evaluate(m, (xe, ye, me)))(
+                stacked)
+        self._jit_eval_global = jax.jit(eval_global)
+        self._metric_names: Optional[list] = None
+        # Device-resident per-node training shards, sliced once.
+        self._node_data_dev = [
+            tuple(jnp.asarray(self.data[k][i])
+                  for k in ("xtr", "ytr", "mtr"))
+            for i in range(self.n_nodes)]
+        # The constant global eval set, uploaded once (not per round).
+        self._eval_set_dev = None
+        if self.has_global_eval:
+            xe = jnp.asarray(self.data["x_eval"])
+            self._eval_set_dev = (xe, jnp.asarray(self.data["y_eval"]),
+                                  jnp.ones(xe.shape[0], jnp.float32))
+
+    # -- setup -------------------------------------------------------------
+
+    def _node_data(self, i: int):
+        return self._node_data_dev[i]
+
+    def init_nodes(self, key: jax.Array, local_train: bool = True,
+                   common_init: bool = False) -> SeqState:
+        n = self.n_nodes
+        k_init, k_phase, k_up = jax.random.split(key, 3)
+        models = []
+        for i in range(n):
+            ki = k_init if common_init else jax.random.fold_in(k_init, i)
+            st = self.handler.init(ki)
+            if local_train:
+                st = self._jit_update(st, self._node_data(i),
+                                      jax.random.fold_in(k_up, i))
+            models.append(st)
+        rng = np.random.default_rng(int(jax.random.randint(
+            k_phase, (), 0, 2 ** 31 - 1)))
+        if self.sync:
+            phase = rng.integers(0, self.delta, size=n)
+        else:
+            phase = np.maximum(
+                (self.delta + (self.delta / 10.0)
+                 * rng.standard_normal(n)).astype(np.int64), 1)
+        balance = (np.asarray(self.account.init_balance(n)).copy()
+                   if self.account is not None else None)
+        return SeqState(models=models, phase=phase, balance=balance)
+
+    def _fires(self, state: SeqState, i: int, t: int) -> bool:
+        if self.sync:
+            return t % self.delta == int(state.phase[i])
+        return t % int(state.phase[i]) == 0
+
+    def _metric_keys(self) -> list:
+        if self._metric_names is None:
+            d = (jnp.asarray(self.data["xtr"][0]),
+                 jnp.asarray(self.data["ytr"][0]),
+                 jnp.asarray(self.data["mtr"][0]))
+            st = self.handler.init(jax.random.PRNGKey(0))
+            self._metric_names = sorted(
+                jax.eval_shape(lambda s: self.handler.evaluate(s, d),
+                               st).keys())
+        return self._metric_names
+
+    # -- the tick loop ------------------------------------------------------
+
+    def start(self, state: SeqState, n_rounds: int = 10,
+              key: Optional[jax.Array] = None):
+        """Run ``n_rounds * delta`` ticks; returns (state, report)."""
+        key = jax.random.PRNGKey(42) if key is None else key
+        rng = np.random.default_rng(
+            int(jax.random.randint(jax.random.fold_in(key, 17), (),
+                                   0, 2 ** 31 - 1)))
+        names = self._metric_keys()
+        n, delta = self.n_nodes, self.delta
+        msg_q: dict = {}   # tick -> [_Pending]; mutated mid-drain by
+        rep_q: dict = {}   # zero-delay replies/reactions (the reference's
+                           # msg_queues/rep_queues DefaultDicts)
+        sent_pr = np.zeros(n_rounds, np.int64)
+        failed_pr = np.zeros(n_rounds, np.int64)
+        size_pr = np.zeros(n_rounds, np.int64)
+        local_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
+        global_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
+        # ONE monotonically increasing event counter feeds every jax-side
+        # draw (handler calls, delay samples): each draw gets a globally
+        # unique fold, so no two events — same tick, same sender, or
+        # different purposes — can share a stream.
+        event_counter = 0
+
+        def next_key():
+            nonlocal event_counter
+            event_counter += 1
+            return jax.random.fold_in(key, event_counter)
+
+        def schedule(rec: MessageRecord, payload, t: int, is_reply=False):
+            """Drop/delay a just-sent message; count + notify.
+
+            Replies are NOT counted here: the reference notifies replies
+            only at their delivery drain (simul.py:425-429), so a dropped
+            or never-delivered reply is never a "sent" message — only a
+            failed one.
+            """
+            r = rec.round
+            if not is_reply:
+                sent_pr[r] += 1
+                size_pr[r] += rec.size
+                self._fire_message(False, rec)
+            if rng.random() < self.drop_prob:
+                failed_pr[r] += 1
+                self._fire_message(True, rec)
+                return
+            d = int(np.asarray(self.delay.sample(next_key(), (1,),
+                                                 rec.size))[0])
+            q = rep_q if is_reply else msg_q
+            q.setdefault(t + d, []).append(_Pending(rec, payload, is_reply))
+
+        def send_from(i: int, t: int, r: int):
+            nbrs = self._nbrs[i]
+            if len(nbrs) == 0:
+                return  # isolated node: skip (reference `break` aborts the
+                        # whole sweep, simul.py:398-399 — a bug)
+            peer = int(nbrs[rng.integers(len(nbrs))])
+            mt = PROTO_TO_MSG[self.protocol]
+            size = 1 if self.protocol == AntiEntropyProtocol.PULL \
+                else self._size
+            payload = None if self.protocol == AntiEntropyProtocol.PULL \
+                else self.handler.peer_view(state.models[i])
+            schedule(MessageRecord(t, r, i, peer, mt, size), payload, t)
+
+        def receive(p: _Pending, t: int, r: int, is_online) -> None:
+            i = p.rec.receiver
+            if not is_online[i]:
+                failed_pr[r] += 1
+                self._fire_message(True, p.rec)
+                return
+            if p.is_reply:
+                # Replies count as sent at DELIVERY (reference
+                # simul.py:425-429 notifies in the rep_queues drain).
+                sent_pr[r] += 1
+                size_pr[r] += p.rec.size
+                self._fire_message(False, p.rec)
+            carries_model = p.payload is not None
+            wants_reply = p.rec.msg_type in (MessageType.PULL,
+                                             MessageType.PUSH_PULL)
+            if carries_model:
+                state.models[i] = self._jit_call(
+                    state.models[i], p.payload, self._node_data(i),
+                    next_key(), None)
+            if wants_reply and not p.is_reply:
+                # Reply carries the receiver's CURRENT (possibly just
+                # merged) model — the sequential semantics the bulk engine
+                # approximates with round-start snapshots.
+                rep = MessageRecord(t, r, i, p.rec.sender, MessageType.REPLY,
+                                    self._size)
+                schedule(rep, self.handler.peer_view(state.models[i]), t,
+                         is_reply=True)
+            elif (self.account is not None and carries_model
+                  and not p.is_reply):  # replies never react (reference
+                                        # rep_queues drain has no reaction)
+                # Token reaction (same tick; can cascade through the drain).
+                util = float(self.utility_fun(state.models[i], p.payload))
+                k = int(np.asarray(self.account.reactive(
+                    jnp.asarray([state.balance[i]]),
+                    jnp.asarray([util], jnp.float32), next_key()))[0])
+                k = min(k, int(state.balance[i]))
+                if k > 0:
+                    state.balance[i] -= k
+                    for _ in range(k):
+                        send_from(i, t, r)
+
+        for t in range(n_rounds * delta):
+            r = t // delta
+            if t % delta == 0:
+                order = rng.permutation(n)
+            # (a) send sweep over the round's shuffled order.
+            for i in order:
+                if not self._fires(state, int(i), t):
+                    continue
+                if self.account is not None:
+                    p = float(np.asarray(self.account.proactive(
+                        jnp.asarray([state.balance[int(i)]])))[0])
+                    if rng.random() >= p:
+                        state.balance[int(i)] += 1  # bank a token
+                        continue
+                send_from(int(i), t, r)
+            # (b) arrival drain — reads the LIVE queue so a zero-delay
+            # reaction scheduled mid-drain is delivered this same tick and
+            # can cascade (the reference appends to the list it iterates).
+            is_online = rng.random(n) <= self.online_prob
+            arrivals = msg_q.get(t, [])
+            idx = 0
+            while idx < len(arrivals):
+                receive(arrivals[idx], t, r, is_online)
+                idx += 1
+            msg_q.pop(t, None)
+            # (c) reply drain (zero-delay replies generated in (b) land
+            # here, same tick — reference rep_queues order).
+            replies = rep_q.get(t, [])
+            idx = 0
+            while idx < len(replies):
+                receive(replies[idx], t, r, is_online)
+                idx += 1
+            rep_q.pop(t, None)
+            # (d) round boundary: evaluate + notify.
+            if (t + 1) % delta == 0:
+                loc, glob = self._evaluate(state, rng)
+                if loc is not None:
+                    local_rows[r] = loc
+                if glob is not None:
+                    global_rows[r] = glob
+                state.round += 1
+
+        report = SimulationReport(
+            metric_names=names,
+            local_evals=local_rows if self.has_local_test else None,
+            global_evals=global_rows if self.has_global_eval else None,
+            sent=sent_pr, failed=failed_pr, total_size=int(size_pr.sum()))
+        self.replay_events(state.round - n_rounds, {
+            "sent": sent_pr, "failed": failed_pr, "size": size_pr,
+            "local": local_rows, "global": global_rows}, names)
+        return state, report
+
+    def _fire_message(self, failed: bool, rec: MessageRecord) -> None:
+        for rx in self._receivers_list():
+            fn = getattr(rx, "update_single_message", None)
+            if fn is not None:
+                fn(failed, rec)
+
+    def _evaluate(self, state: SeqState, rng):
+        names = self._metric_keys()
+        n = self.n_nodes
+        if self.sampling_eval > 0:
+            pick = rng.choice(n, max(int(n * self.sampling_eval), 1),
+                              replace=False)
+        else:
+            pick = np.arange(n)
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls),
+                               *[state.models[i] for i in pick])
+        loc = None
+        if self.has_local_test:
+            d = (jnp.asarray(self.data["xte"][pick]),
+                 jnp.asarray(self.data["yte"][pick]),
+                 jnp.asarray(self.data["mte"][pick]))
+            res = self._jit_eval_batch(stacked, d)
+            has_test = self.data["mte"][pick].sum(axis=1) > 0
+            if has_test.any():
+                vals = np.stack([np.asarray(res[k]) for k in names], -1)
+                loc = vals[has_test].mean(0)
+        glob = None
+        if self.has_global_eval:
+            xe, ye, me = self._eval_set_dev
+            res = self._jit_eval_global(stacked, xe, ye, me)
+            glob = np.stack([np.asarray(res[k]) for k in names], -1).mean(0)
+        return loc, glob

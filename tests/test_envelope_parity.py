@@ -32,17 +32,26 @@ from test_golden_parity import import_reference, make_dataset, D
 pytestmark = pytest.mark.parity
 
 N_NODES = 16
-N_SEEDS = 5
+# 10 seeds (round-4 verdict #5: 5 was statistically loose). Ours runs all
+# seeds in ONE compiled program (run_repetitions), so the cost lands on the
+# reference side only.
+N_SEEDS = 10
 PENS_ROUNDS = 16
 PENS_STEP1 = 8
 TOKEN_ROUNDS = 32
 
 
 def assert_envelopes_overlap(curves_ref, curves_ours, label,
-                             burn_frac=0.4, slack=0.06):
-    """Mean learning curves must agree within the combined 2-sigma seed
-    envelopes on the post-burn-in tail — a curve-shape contract, not just a
-    final-accuracy one."""
+                             burn_frac=0.4, slack=0.02):
+    """Mean learning curves must agree within 2 standard errors of the
+    mean difference plus a small flat slack on the post-burn-in tail — a
+    curve-shape contract, not just a final-accuracy one.
+
+    Round-5 tightening (verdict #5): the tolerance uses the SEM
+    (``sigma / sqrt(S)``), not the per-seed scatter, and the flat slack
+    dropped 0.06 -> 0.02 — a systematic ~5-point offset now FAILS (the
+    mutation test below proves the teeth).
+    """
     ref = np.asarray(curves_ref, dtype=np.float64)
     ours = np.asarray(curves_ours, dtype=np.float64)
     assert ref.shape == ours.shape == (N_SEEDS, ref.shape[1]), \
@@ -51,7 +60,7 @@ def assert_envelopes_overlap(curves_ref, curves_ours, label,
     m_o, s_o = ours.mean(0), ours.std(0)
     tail = slice(int(ref.shape[1] * burn_frac), None)
     gap = np.abs(m_r[tail] - m_o[tail])
-    tol = 2.0 * (s_r[tail] + s_o[tail]) + slack
+    tol = 2.0 * (s_r[tail] + s_o[tail]) / np.sqrt(N_SEEDS) + slack
     assert (gap <= tol).all(), (
         f"{label}: mean-curve gap exceeds the seed envelope on the tail:\n"
         f"ref  mean {np.round(m_r, 3)}\nours mean {np.round(m_o, 3)}\n"
@@ -126,7 +135,15 @@ def run_ours_pens_curves(X, y) -> list:
     return [r.curves(local=False)["accuracy"] for r in reports]
 
 
-def run_reference_tokenized_curves(X, y) -> list:
+_REF_TOKEN_CACHE: dict = {}
+
+
+def run_reference_tokenized_curves(X, y, cache_key=None):
+    """Per-seed accuracy curves AND per-round sent-message counts (the
+    quantity flow control actually changes — verdict r4 #6). Cached per
+    dataset: the mutation test reuses the same reference runs."""
+    if cache_key in _REF_TOKEN_CACHE:
+        return _REF_TOKEN_CACHE[cache_key]
     import torch
     from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
         CreateModelMode as RefMode, StaticP2PNetwork
@@ -134,10 +151,28 @@ def run_reference_tokenized_curves(X, y) -> list:
     from gossipy.model.handler import TorchModelHandler
     from gossipy.model.nn import LogisticRegression as RefLogReg
     from gossipy.node import GossipNode
-    from gossipy.simul import SimulationReport, \
-        TokenizedGossipSimulator as RefTGS
+    from gossipy.simul import SimulationEventReceiver as RefRx, \
+        SimulationReport, TokenizedGossipSimulator as RefTGS
 
-    curves = []
+    class SentPerRound(RefRx):
+        """Reference-side per-message counter -> per-round sent curve."""
+
+        def __init__(self):
+            self.counts = np.zeros(TOKEN_ROUNDS, np.int64)
+
+        def update_message(self, failed, msg=None):
+            if not failed and msg is not None:
+                r = int(msg.timestamp) // 20
+                if r < TOKEN_ROUNDS:
+                    self.counts[r] += 1
+
+        def update_timestep(self, t):  # abstract in the reference ABC
+            pass
+
+        def update_end(self):
+            pass
+
+    curves, sents = [], []
     for seed in range(N_SEEDS):
         disp = _ref_common(seed, X, y)
         proto = TorchModelHandler(
@@ -155,17 +190,24 @@ def run_reference_tokenized_curves(X, y) -> list:
                      delay=ConstantDelay(0), online_prob=1.0, drop_prob=0.0,
                      sampling_eval=0.0)
         report = SimulationReport()
+        counter = SentPerRound()
         sim.add_receiver(report)
+        sim.add_receiver(counter)
         sim.init_nodes(seed=seed)
         with contextlib.redirect_stdout(io.StringIO()):
             sim.start(n_rounds=TOKEN_ROUNDS)
         curves.append(_ref_curve(report))
-    return curves
+        sents.append(counter.counts.copy())
+    out = (curves, np.asarray(sents, np.float64))
+    if cache_key is not None:
+        _REF_TOKEN_CACHE[cache_key] = out
+    return out
 
 
-def run_ours_tokenized_curves(X, y) -> list:
+def run_ours_tokenized_curves(X, y, max_reactions: int = 3):
     """All S seeds in ONE compiled program via run_repetitions — the
-    multi-seed path this test exists to exercise."""
+    multi-seed path this test exists to exercise. ``max_reactions=0`` is
+    the mutation knob (reactive sends killed)."""
     dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
     disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
     handler = SGDHandler(
@@ -176,10 +218,47 @@ def run_ours_tokenized_curves(X, y) -> list:
     sim = TokenizedGossipSimulator(
         handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
         protocol=AntiEntropyProtocol.PUSH,
-        token_account=RandomizedTokenAccount(C=20, A=10))
+        token_account=RandomizedTokenAccount(C=20, A=10),
+        max_reactions=max_reactions)
     keys = jax.random.split(jax.random.PRNGKey(42), N_SEEDS)
     _, reports = sim.run_repetitions(TOKEN_ROUNDS, keys)
-    return [r.curves(local=False)["accuracy"] for r in reports]
+    return ([r.curves(local=False)["accuracy"] for r in reports],
+            np.asarray([r.sent_per_round for r in reports], np.float64))
+
+
+def assert_sent_curves_close(ref_s, ours_s, label="tokenized sent",
+                             lag_tolerance=True):
+    """CUMULATIVE send-count curves must track within 2 SEM + 8%.
+
+    Cumulative (not per-round) because the bulk engine delivers token
+    reactions NEXT round (documented divergence, variants.py
+    _post_deliver): the reaction burst at flow onset lands one round later
+    than the reference's same-tick cascade, so per-round curves gap by the
+    whole burst (~20 messages) at the onset edge while the running totals
+    stay aligned. ``lag_tolerance`` lets OUR cumulative curve lag the
+    reference's by at most one round (never lead) — exactly the
+    divergence; the sequential engine's parity test passes the per-round
+    contract with no allowance at all (test_sequential_parity). A LEVEL
+    difference (reactions killed — the mutation test below) accumulates
+    linearly and is not rescued by a one-round lag.
+    """
+    cum_r = np.cumsum(ref_s, axis=1)
+    cum_o = np.cumsum(ours_s, axis=1)
+    m_r, m_o = cum_r.mean(0), cum_o.mean(0)
+    gap = np.abs(m_r - m_o)
+    if lag_tolerance:
+        lag = np.abs(m_r[:-1] - m_o[1:])    # ours one round behind
+        gap = np.minimum(gap, np.concatenate([lag, [gap[-1]]]))
+    # 8% relative: the measured transient is a ~6.4% cumulative deficit
+    # peaking mid-spend (our capped next-round reactions briefly bank more
+    # tokens than the reference's same-tick cascade) that decays to ~1% by
+    # the horizon; the mutation deficit (reactions killed) grows to a
+    # 20-30% shortfall and fails decisively.
+    tol = 2.0 * (cum_r.std(0) + cum_o.std(0)) / np.sqrt(N_SEEDS) \
+        + 0.08 * np.maximum(m_r, N_NODES)
+    assert (gap <= tol).all(), (
+        f"{label}: cumulative send-curve gap {np.round(gap, 1)} vs tol "
+        f"{np.round(tol, 1)}")
 
 
 class TestEnvelopeParity:
@@ -191,7 +270,15 @@ class TestEnvelopeParity:
         X, y = make_dataset(seed=3)
         ref = run_reference_pens_curves(X, y)
         ours = run_ours_pens_curves(X, y)
-        assert_envelopes_overlap(ref, ours, "PENS")
+        # 0.6 burn-in + 0.03 slack under the round-5 SEM tolerance: ours
+        # starts from a lower init plateau (torch-vs-jax init
+        # distribution, the phenomenon measured at 0.114 in
+        # test_sequential_parity) and converges from below with a
+        # monotonically decaying gap (0.040 -> 0.022 over the tail); the
+        # slack still fails a 5-point systematic offset, which the old
+        # 2-sigma+0.06 contract would have passed.
+        assert_envelopes_overlap(ref, ours, "PENS", burn_frac=0.6,
+                                 slack=0.03)
         assert np.mean([c[-1] for c in ref]) > 0.8
         assert np.mean([c[-1] for c in ours]) > 0.8
 
@@ -201,8 +288,8 @@ class TestEnvelopeParity:
         except Exception as e:  # pragma: no cover - env-specific
             pytest.skip(f"reference not importable: {e!r}")
         X, y = make_dataset(seed=4)
-        ref = run_reference_tokenized_curves(X, y)
-        ours = run_ours_tokenized_curves(X, y)
+        ref, ref_sent = run_reference_tokenized_curves(X, y, cache_key=4)
+        ours, ours_sent = run_ours_tokenized_curves(X, y)
         # Burn-in covers the token-charge transient (~C=20 rounds): during
         # it the reference's reactive sends can deliver within the SAME
         # tick while the engine's earliest reactive delivery is next round
@@ -213,3 +300,19 @@ class TestEnvelopeParity:
         assert_envelopes_overlap(ref, ours, "tokenized", burn_frac=0.6)
         assert np.mean([c[-1] for c in ref]) > 0.7
         assert np.mean([c[-1] for c in ours]) > 0.7
+        # Message-count curves: the quantity flow control changes.
+        assert_sent_curves_close(ref_sent, ours_sent)
+
+    def test_tokenized_envelope_has_teeth(self):
+        """Mutation check (verdict r4 #5): deliberately break reaction
+        accounting (max_reactions=0 kills every reactive send) and the
+        send-count contract must FAIL against the same reference runs."""
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=4)
+        _, ref_sent = run_reference_tokenized_curves(X, y, cache_key=4)
+        _, mutant_sent = run_ours_tokenized_curves(X, y, max_reactions=0)
+        with pytest.raises(AssertionError, match="send-curve"):
+            assert_sent_curves_close(ref_sent, mutant_sent)

@@ -1,0 +1,162 @@
+"""Sequential high-fidelity engine (simulation/sequential.py): semantics,
+per-message events, same-tick token reactions, and agreement with the bulk
+engine. The torch-reference comparison lives in the parity lane
+(test_sequential_parity.py)."""
+
+import numpy as np
+import jax
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+    Topology, UniformDelay
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.flow_control import SimpleTokenAccount
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import GossipSimulator, \
+    SequentialGossipSimulator, SimulationEventReceiver
+
+N, D, DELTA = 16, 12, 20
+
+
+def make_handler():
+    return SGDHandler(model=LogisticRegression(D, 2),
+                      loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
+                      local_epochs=1, batch_size=32, n_classes=2,
+                      input_shape=(D,),
+                      create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+
+def make_parts(seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(480, D)).astype(np.float32)
+    y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=N, eval_on_user=False)
+    return disp.stacked(), Topology.random_regular(N, 6, seed=7)
+
+
+class Recorder(SimulationEventReceiver):
+    def __init__(self):
+        self.sent = []      # (t, round, sender, receiver, type)
+        self.failed = 0
+        self.rounds = 0
+
+    def update_single_message(self, failed, m):
+        if failed:
+            self.failed += 1
+        else:
+            self.sent.append((m.t, m.round, m.sender, m.receiver, m.msg_type))
+
+    def update_timestep(self, r):
+        self.rounds += 1
+
+
+class TestSequentialSemantics:
+    def test_push_message_accounting_and_per_message_events(self, key):
+        data, topo = make_parts()
+        sim = SequentialGossipSimulator(make_handler(), topo, data,
+                                        delta=DELTA)
+        rec = Recorder()
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=6, key=jax.random.fold_in(key, 1))
+        # Every sync node fires exactly once per round; no faults => every
+        # send is also a per-message observer event (reference
+        # notify_message granularity).
+        assert report.sent_messages == 6 * N
+        assert len(rec.sent) == 6 * N
+        assert rec.failed == 0
+        assert rec.rounds == 6
+        # Learning happens through the public metric surface.
+        acc = report.curves(local=False)["accuracy"]
+        assert np.isfinite(acc).all()
+        assert acc[-1] > acc[0]
+
+    def test_faults_counted(self, key):
+        data, topo = make_parts()
+        sim = SequentialGossipSimulator(make_handler(), topo, data,
+                                        delta=DELTA, drop_prob=0.3,
+                                        online_prob=0.7,
+                                        delay=UniformDelay(0, 30))
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=6, key=jax.random.fold_in(key, 1))
+        assert report.failed_messages > 0
+        assert np.isfinite(report.curves(local=False)["accuracy"]).all()
+
+    def test_push_pull_replies_flow(self, key):
+        data, topo = make_parts()
+        sim = SequentialGossipSimulator(make_handler(), topo, data,
+                                        delta=DELTA,
+                                        protocol=AntiEntropyProtocol.PUSH_PULL)
+        rec = Recorder()
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=5, key=jax.random.fold_in(key, 1))
+        from gossipy_tpu.core import MessageType
+        replies = [m for m in rec.sent if m[4] == MessageType.REPLY]
+        assert len(replies) > 0
+        # Replies counted in the totals (reference counts both legs).
+        assert report.sent_messages == len(rec.sent)
+
+    def test_tokenized_same_tick_reactions(self, key):
+        data, topo = make_parts()
+        sim = SequentialGossipSimulator(
+            make_handler(), topo, data, delta=DELTA,
+            token_account=SimpleTokenAccount(C=4))
+        rec = Recorder()
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=6, key=jax.random.fold_in(key, 1))
+        # A reaction is emitted at the RECEIVE tick, which (zero delay) is
+        # the trigger's send tick — so some send happens at a tick that is
+        # NOT the sender's own phase offset. The bulk engine can only
+        # deliver reactions next round; this is the same-tick fidelity the
+        # mode exists for (reference simul.py:631-648).
+        phases = st.phase
+        off_phase = [m for m in rec.sent
+                     if m[0] % DELTA != int(phases[m[2]])]
+        assert len(off_phase) > 0, "no same-tick reactive sends observed"
+
+    def test_isolated_node_skips_not_aborts(self, key):
+        # Reference bug: an isolated sender `break`s the whole send sweep
+        # (simul.py:398-399); here it only skips itself — everyone else
+        # still sends every round.
+        adj = np.zeros((4, 4))
+        adj[0, 1] = adj[1, 0] = adj[1, 2] = adj[2, 1] = 1
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(80, D)).astype(np.float32)
+        y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=4, eval_on_user=False)
+        sim = SequentialGossipSimulator(make_handler(), Topology(adj),
+                                        disp.stacked(), delta=DELTA)
+        st = sim.init_nodes(key)
+        st, report = sim.start(st, n_rounds=4, key=jax.random.fold_in(key, 1))
+        assert report.sent_messages == 4 * 3  # node 3 isolated, 3 senders
+
+
+class TestSequentialVsBulk:
+    def test_mean_curves_agree(self, key):
+        """The two engines' divergences (snapshots, next-round reactions)
+        are bounded: 3-seed mean accuracy curves agree within 0.06 on the
+        small config (measured gap ~0.03; sequential runs slightly ahead
+        late — in-round freshness propagates information faster)."""
+        data, topo = make_parts()
+        seq, blk = [], []
+        for s in range(3):
+            k = jax.random.PRNGKey(100 + s)
+            sim_s = SequentialGossipSimulator(make_handler(), topo, data,
+                                              delta=DELTA)
+            st = sim_s.init_nodes(k)
+            _, rp = sim_s.start(st, n_rounds=8, key=jax.random.fold_in(k, 1))
+            seq.append(rp.curves(local=False)["accuracy"])
+            sim_b = GossipSimulator(make_handler(), topo, data, delta=DELTA)
+            stb = sim_b.init_nodes(k)
+            _, rb = sim_b.start(stb, n_rounds=8, key=jax.random.fold_in(k, 1))
+            blk.append(rb.curves(local=False)["accuracy"])
+        gap = np.max(np.abs(np.mean(seq, 0) - np.mean(blk, 0)))
+        assert gap < 0.06, f"sequential/bulk mean-curve gap {gap:.3f}"
+        # Same message volume on the fault-free PUSH config.
+        assert rp.sent_messages == rb.sent_messages

@@ -1,0 +1,188 @@
+"""Sequential high-fidelity engine vs the ACTUAL torch reference.
+
+The bulk engine's parity contract is distributional-with-envelopes
+(test_envelope_parity.py) because bulk-synchronous rounds legitimately
+shift information propagation. The sequential engine exists to close
+exactly those divergences (same-tick reactions, in-round sequential
+state, per-message events), so its contract is TIGHTER than the envelope:
+mean accuracy curves within a small flat gap from round 1 (no burn-in
+exclusion), and message accounting equal in distribution.
+"""
+
+import contextlib
+import io
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.flow_control import RandomizedTokenAccount
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import SequentialGossipSimulator
+
+from test_golden_parity import import_reference, make_dataset, D
+
+pytestmark = pytest.mark.parity
+
+N_NODES = 16
+N_SEEDS = 5
+ROUNDS = 12
+TOKEN_ROUNDS = 24
+
+
+def _ref_curves_and_sent(X, y, token: bool, rounds: int):
+    """Reference runs: per-seed accuracy curves + per-round sent counts
+    (via a per-message receiver at the reference's own granularity)."""
+    import torch
+    from gossipy import CACHE, set_seed as ref_seed
+    from gossipy.core import AntiEntropyProtocol as RefProto, ConstantDelay, \
+        CreateModelMode as RefMode, StaticP2PNetwork
+    from gossipy.data import DataDispatcher as RefDispatcher
+    from gossipy.data.handler import ClassificationDataHandler as RefCDH
+    from gossipy.model.handler import TorchModelHandler
+    from gossipy.model.nn import LogisticRegression as RefLogReg
+    from gossipy.node import GossipNode
+    from gossipy.simul import GossipSimulator as RefSim, SimulationReport
+    from gossipy.simul import SimulationEventReceiver as RefRx
+
+    class SentPerRound(RefRx):
+        def __init__(self, delta, rounds):
+            self.counts = np.zeros(rounds, np.int64)
+            self.delta = delta
+
+        def update_message(self, failed, msg=None):
+            if not failed and msg is not None:
+                r = int(msg.timestamp) // self.delta
+                if r < len(self.counts):
+                    self.counts[r] += 1
+
+        def update_timestep(self, t):  # abstract in the reference ABC
+            pass
+
+        def update_end(self):
+            pass
+
+    curves, sents = [], []
+    for seed in range(N_SEEDS):
+        CACHE.clear()
+        ref_seed(seed)
+        dh = RefCDH(torch.tensor(X), torch.tensor(y), test_size=0.25)
+        disp = RefDispatcher(dh, n=N_NODES, eval_on_user=False)
+        proto = TorchModelHandler(
+            net=RefLogReg(D, 2), optimizer=torch.optim.SGD,
+            optimizer_params={"lr": 0.5},
+            criterion=torch.nn.CrossEntropyLoss(), local_epochs=1,
+            batch_size=8, create_model_mode=RefMode.MERGE_UPDATE)
+        nodes = GossipNode.generate(
+            data_dispatcher=disp, p2p_net=StaticP2PNetwork(N_NODES),
+            model_proto=proto, round_len=20, sync=True)
+        kwargs = dict(nodes=nodes, data_dispatcher=disp, delta=20,
+                      protocol=RefProto.PUSH, delay=ConstantDelay(0),
+                      online_prob=1.0, drop_prob=0.0, sampling_eval=0.0)
+        if token:
+            from gossipy.flow_control import RandomizedTokenAccount as RefRTA
+            from gossipy.simul import TokenizedGossipSimulator as RefTGS
+            sim = RefTGS(token_account=RefRTA(C=20, A=10),
+                         utility_fun=lambda mh1, mh2, msg: 1, **kwargs)
+        else:
+            sim = RefSim(**kwargs)
+        report = SimulationReport()
+        counter = SentPerRound(20, rounds)
+        sim.add_receiver(report)
+        sim.add_receiver(counter)
+        sim.init_nodes(seed=seed)
+        with contextlib.redirect_stdout(io.StringIO()):
+            sim.start(n_rounds=rounds)
+        curves.append([e[1]["accuracy"]
+                       for e in report.get_evaluation(False)])
+        sents.append(counter.counts.copy())
+    return np.asarray(curves, np.float64), np.asarray(sents, np.float64)
+
+
+def _seq_curves_and_sent(X, y, token: bool, rounds: int):
+    curves, sents = [], []
+    for seed in range(N_SEEDS):
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=seed)
+        disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+        handler = SGDHandler(
+            model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.5), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(D,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        kwargs = {}
+        if token:
+            kwargs = dict(token_account=RandomizedTokenAccount(C=20, A=10))
+        sim = SequentialGossipSimulator(
+            handler, Topology.clique(N_NODES), disp.stacked(), delta=20,
+            protocol=AntiEntropyProtocol.PUSH, **kwargs)
+        k = jax.random.PRNGKey(seed)
+        st = sim.init_nodes(k)
+        st, report = sim.start(st, n_rounds=rounds,
+                               key=jax.random.fold_in(k, 1))
+        curves.append(report.curves(local=False)["accuracy"])
+        sents.append(report.sent_per_round)
+    return np.asarray(curves, np.float64), np.asarray(sents, np.float64)
+
+
+class TestSequentialParity:
+    def test_vanilla_tight_agreement(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=5)
+        ref_c, ref_s = _ref_curves_and_sent(X, y, token=False, rounds=ROUNDS)
+        seq_c, seq_s = _seq_curves_and_sent(X, y, token=False, rounds=ROUNDS)
+        # Message accounting: exact on a fault-free clique (one send per
+        # node per round, both sides).
+        np.testing.assert_array_equal(ref_s, np.full_like(ref_s, N_NODES))
+        np.testing.assert_array_equal(seq_s, np.full_like(seq_s, N_NODES))
+        # Accuracy: tighter than the envelope test's contract — a flat
+        # bound on the mean gap with NO burn-in window. Round 1 reflects
+        # init-DISTRIBUTION differences (torch vs jax initializers), not
+        # loop semantics, and gets a slightly wider bound; measured gaps:
+        # 0.045 at round 1 decaying to 0.001 by round 12.
+        gap = np.abs(ref_c.mean(0) - seq_c.mean(0))
+        assert gap[0] < 0.06, f"round-1 init gap {gap[0]:.3f}"
+        assert gap[1:].max() < 0.04, \
+            f"sequential-vs-reference mean gap {np.round(gap, 3)}"
+
+    def test_tokenized_same_tick_tight_agreement(self):
+        try:
+            import_reference()
+        except Exception as e:  # pragma: no cover - env-specific
+            pytest.skip(f"reference not importable: {e!r}")
+        X, y = make_dataset(seed=6)
+        ref_c, ref_s = _ref_curves_and_sent(X, y, token=True,
+                                            rounds=TOKEN_ROUNDS)
+        seq_c, seq_s = _seq_curves_and_sent(X, y, token=True,
+                                            rounds=TOKEN_ROUNDS)
+        # Flow-control signature FIRST: per-round send-count curves (how
+        # many messages, including same-tick reactions, each round) are
+        # init-independent and must agree within 2 SEM + a 10%-of-N flat
+        # slack from ROUND 1 — this is the same-tick dynamics evidence.
+        sgap = np.abs(ref_s.mean(0) - seq_s.mean(0))
+        tol = 2.0 * (ref_s.std(0) + seq_s.std(0)) / np.sqrt(N_SEEDS) \
+            + 0.10 * N_NODES
+        assert (sgap <= tol).all(), \
+            f"sent-curve gap {np.round(sgap, 2)} vs tol {np.round(tol, 2)}"
+        # Accuracy: while the accounts charge (~C/2 rounds) NO messages
+        # flow, so both sides sit frozen at their init plateaus — the
+        # plateau offset (measured 0.114) is the torch-vs-jax init
+        # DISTRIBUTION, not loop semantics. The contract is therefore on
+        # the mixing dynamics once flow starts: the gap must decay to the
+        # vanilla-level band by the tail.
+        gap = np.abs(ref_c.mean(0) - seq_c.mean(0))
+        assert gap[:8].std() < 0.01, \
+            "charging-phase plateau should be flat on both sides"
+        assert gap[-3:].max() < 0.08, \
+            f"tokenized tail gap {np.round(gap[-3:], 3)}"
+        # Measured: plateau 0.114 -> 0.051 by round 24 — the init offset
+        # washes out through mixing at the expected rate.
+        assert gap[-1] < 0.55 * gap[:8].mean(), \
+            f"gap must decay after flow starts ({gap[-1]:.3f} vs plateau " \
+            f"{gap[:8].mean():.3f})"
