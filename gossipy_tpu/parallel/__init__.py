@@ -93,13 +93,31 @@ def make_mesh_2d(n_hosts: int, devices_per_host: Optional[int] = None,
             (per,), (n_hosts,), devices=devs[: n_hosts * per])
         arr = np.asarray(arr).reshape(n_hosts, per)
     except Exception:
-        # Single-process backends (CPU test mesh, one-host TPU) have no host
-        # boundaries to respect — a plain reshape is exact. On a real
-        # multi-process run a failed hybrid mesh must NOT silently degrade
-        # to device order (the dcn axis would cut across ICI).
         if jax.process_count() > 1:
-            raise
-        arr = np.array(devs[: n_hosts * per]).reshape(n_hosts, per)
+            # The hybrid helper keys on slice metadata that CPU/virtual
+            # clusters do not carry ("Number of slices 1 ..."). There the
+            # process boundary IS the host boundary: order devices
+            # host-contiguously by process_index and verify no inner-axis
+            # row straddles a process — the exact property the helper
+            # exists to guarantee. A layout that cannot satisfy it still
+            # raises rather than silently cutting the dcn axis across ICI.
+            by_host: dict[int, list] = {}
+            for d in devs:
+                by_host.setdefault(d.process_index, []).append(d)
+            hosts = [sorted(v, key=lambda d: d.id)
+                     for _, v in sorted(by_host.items())]
+            flat = [d for h in hosts for d in h]
+            arr = np.array(flat[: n_hosts * per]).reshape(n_hosts, per)
+            if any(len({d.process_index for d in row}) != 1 for row in arr):
+                raise ValueError(
+                    f"make_mesh_2d({n_hosts}, {per}): an inner-axis row "
+                    f"would straddle a process boundary (processes have "
+                    f"{[len(h) for h in hosts]} devices); choose "
+                    f"devices_per_host dividing the per-process count")
+        else:
+            # Single-process backends (CPU test mesh, one-host TPU) have
+            # no host boundaries to respect — a plain reshape is exact.
+            arr = np.array(devs[: n_hosts * per]).reshape(n_hosts, per)
     return Mesh(arr, axis_names)
 
 

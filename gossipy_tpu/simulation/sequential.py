@@ -163,12 +163,17 @@ class SequentialGossipSimulator(SimulationEventSender):
             tuple(jnp.asarray(self.data[k][i])
                   for k in ("xtr", "ytr", "mtr"))
             for i in range(self.n_nodes)]
-        # The constant global eval set, uploaded once (not per round).
+        # The constant global eval set and the stacked local test sets,
+        # uploaded once (not per round).
         self._eval_set_dev = None
         if self.has_global_eval:
             xe = jnp.asarray(self.data["x_eval"])
             self._eval_set_dev = (xe, jnp.asarray(self.data["y_eval"]),
                                   jnp.ones(xe.shape[0], jnp.float32))
+        self._test_set_dev = None
+        if self.has_local_test:
+            self._test_set_dev = tuple(jnp.asarray(self.data[k])
+                                       for k in ("xte", "yte", "mte"))
 
     # -- setup -------------------------------------------------------------
 
@@ -221,9 +226,11 @@ class SequentialGossipSimulator(SimulationEventSender):
               key: Optional[jax.Array] = None):
         """Run ``n_rounds * delta`` ticks; returns (state, report)."""
         key = jax.random.PRNGKey(42) if key is None else key
+        # Split, don't fold: the host-scheduling seed must live in a key
+        # space disjoint from next_key()'s fold_in(key, counter) draws.
+        k_host, key = jax.random.split(key)
         rng = np.random.default_rng(
-            int(jax.random.randint(jax.random.fold_in(key, 17), (),
-                                   0, 2 ** 31 - 1)))
+            int(jax.random.randint(k_host, (), 0, 2 ** 31 - 1)))
         names = self._metric_keys()
         n, delta = self.n_nodes, self.delta
         msg_q: dict = {}   # tick -> [_Pending]; mutated mid-drain by
@@ -315,9 +322,13 @@ class SequentialGossipSimulator(SimulationEventSender):
                 k = int(np.asarray(self.account.reactive(
                     jnp.asarray([state.balance[i]]),
                     jnp.asarray([util], jnp.float32), next_key()))[0])
-                k = min(k, int(state.balance[i]))
                 if k > 0:
-                    state.balance[i] -= k
+                    # Reference fidelity: ALL reactive sends are emitted
+                    # and the balance clamps at zero (simul.py:640-648 +
+                    # flow_control sub()); the bulk engine instead caps
+                    # sends at the balance — for the in-tree accounts,
+                    # whose reactive() never exceeds it, the two agree.
+                    state.balance[i] = max(0, int(state.balance[i]) - k)
                     for _ in range(k):
                         send_from(i, t, r)
 
@@ -391,9 +402,8 @@ class SequentialGossipSimulator(SimulationEventSender):
                                *[state.models[i] for i in pick])
         loc = None
         if self.has_local_test:
-            d = (jnp.asarray(self.data["xte"][pick]),
-                 jnp.asarray(self.data["yte"][pick]),
-                 jnp.asarray(self.data["mte"][pick]))
+            idx = jnp.asarray(pick)
+            d = tuple(a[idx] for a in self._test_set_dev)  # device gather
             res = self._jit_eval_batch(stacked, d)
             has_test = self.data["mte"][pick].sum(axis=1) > 0
             if has_test.any():

@@ -151,26 +151,10 @@ def run_reference_tokenized_curves(X, y, cache_key=None):
     from gossipy.model.handler import TorchModelHandler
     from gossipy.model.nn import LogisticRegression as RefLogReg
     from gossipy.node import GossipNode
-    from gossipy.simul import SimulationEventReceiver as RefRx, \
-        SimulationReport, TokenizedGossipSimulator as RefTGS
+    from gossipy.simul import SimulationReport, \
+        TokenizedGossipSimulator as RefTGS
 
-    class SentPerRound(RefRx):
-        """Reference-side per-message counter -> per-round sent curve."""
-
-        def __init__(self):
-            self.counts = np.zeros(TOKEN_ROUNDS, np.int64)
-
-        def update_message(self, failed, msg=None):
-            if not failed and msg is not None:
-                r = int(msg.timestamp) // 20
-                if r < TOKEN_ROUNDS:
-                    self.counts[r] += 1
-
-        def update_timestep(self, t):  # abstract in the reference ABC
-            pass
-
-        def update_end(self):
-            pass
+    from test_golden_parity import make_sent_per_round_receiver
 
     curves, sents = [], []
     for seed in range(N_SEEDS):
@@ -190,7 +174,7 @@ def run_reference_tokenized_curves(X, y, cache_key=None):
                      delay=ConstantDelay(0), online_prob=1.0, drop_prob=0.0,
                      sampling_eval=0.0)
         report = SimulationReport()
-        counter = SentPerRound()
+        counter = make_sent_per_round_receiver(20, TOKEN_ROUNDS)
         sim.add_receiver(report)
         sim.add_receiver(counter)
         sim.init_nodes(seed=seed)

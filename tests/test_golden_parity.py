@@ -63,6 +63,32 @@ def import_reference():
     return True
 
 
+def make_sent_per_round_receiver(delta: int, rounds: int):
+    """Reference-side per-message counter -> per-round sent-count curve
+    (shared by the envelope and sequential parity suites). Requires
+    ``import_reference()`` to have run."""
+    import numpy as _np
+    from gossipy.simul import SimulationEventReceiver as RefRx
+
+    class SentPerRound(RefRx):
+        def __init__(self):
+            self.counts = _np.zeros(rounds, _np.int64)
+
+        def update_message(self, failed, msg=None):
+            if not failed and msg is not None:
+                r = int(msg.timestamp) // delta
+                if r < rounds:
+                    self.counts[r] += 1
+
+        def update_timestep(self, t):  # abstract in the reference ABC
+            pass
+
+        def update_end(self):
+            pass
+
+    return SentPerRound()
+
+
 def run_reference(X, y) -> float:
     """Final global test accuracy from the reference simulator."""
     import torch
