@@ -64,6 +64,15 @@ def emit(payload: dict) -> None:
     raw["degraded"] = DEGRADED
     if DEGRADED and os.environ.get("GOSSIPY_TPU_DEGRADE_REASON"):
         raw["degrade_reason"] = os.environ["GOSSIPY_TPU_DEGRADE_REASON"]
+    if raw["backend"] == "cpu" and not DEGRADED:
+        # The liveness probe only proves jax INITIALIZES — an accelerator
+        # plugin that silently falls back (or a plugin-free environment)
+        # reaches here on the CPU backend without having tripped the
+        # degrade path. A CPU row must never reach the driver unlabeled.
+        raw["degraded"] = True
+        raw.setdefault("degrade_reason",
+                       "backend initialized as cpu (accelerator absent or "
+                       "plugin fell back)")
     print(json.dumps(payload))
 
 
@@ -252,7 +261,8 @@ PEAK_FLOPS = {
 
 def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
               n_train: int | None = None, n_test: int | None = None,
-              variant: str = "vanilla", eval_every: int = 5) -> None:
+              variant: str = "vanilla", eval_every: int = 5,
+              compact: bool = True) -> None:
     """Model-FLOPs-utilization for the CNN north-star config.
 
     Runs the CIFAR-10 100-node CNN round program (CIFAR-shaped synthetic
@@ -358,7 +368,10 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
         return GossipSimulator(
             handler, topo, data, delta=ROUND_LEN,
             protocol=AntiEntropyProtocol.PUSH, sampling_eval=0.1,
-            eval_every=ev)
+            eval_every=ev,
+            # compact=False: the on-chip A/B control (--mfu-wide) — the
+            # full-width masked slot passes the round-3 MFU row measured.
+            compact_deliver=None if compact else False)
 
     sim = make_sim(stacked, eval_every)
     import jax.random as jrandom
@@ -420,7 +433,8 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
           file=sys.stderr)
     emit({
         "metric": "mfu_cifar10_100nodes_cnn" + (
-            "_all2all" if variant == "all2all" else ""),
+            "_all2all" if variant == "all2all" else "") + (
+            "" if compact else "_widepass"),
         "value": round(mfu, 4) if mfu is not None else None,
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu, 4) if mfu is not None else None,
@@ -1095,6 +1109,9 @@ when the accelerator is unreachable or wedges mid-run.
 
 modes (default: the 100-node north-star, ours vs the live reference):
   --mfu [ROUNDS]            CNN-config MFU vs the chip's bf16 peak
+  --mfu-wide [ROUNDS]       same, compact_deliver off (full-width masked
+                            slot passes): the on-chip A/B control for the
+                            round-5 compaction
   --mfu-all2all [ROUNDS]    same workload under the All2All protocol (the
                             one-einsum merge: the engine's MFU upper end)
   --scale [N]               N-node rounds/s over a CSR SparseTopology
@@ -1128,6 +1145,9 @@ def main():
     if "--mfu-all2all" in sys.argv:
         mode, mode_arg = "mfu-all2all", _mode_arg("--mfu-all2all",
                                                   default=50, minimum=1)
+    elif "--mfu-wide" in sys.argv:
+        mode, mode_arg = "mfu-wide", _mode_arg("--mfu-wide",
+                                               default=50, minimum=1)
     elif "--mfu" in sys.argv:
         mode, mode_arg = "mfu", _mode_arg("--mfu", default=50, minimum=1)
     elif "--scale-all2all" in sys.argv:
@@ -1156,7 +1176,7 @@ def main():
         deadline = 1500.0 + 0.025 * mode_arg
     elif mode == "fused":
         deadline = 2400.0  # two full CNN-clique compiles + 2x2 passes
-    elif mode in ("mfu", "mfu-all2all"):
+    elif mode in ("mfu", "mfu-wide", "mfu-all2all"):
         deadline = 2400.0  # up to 3 CNN compiles (FLOP decomposition + timed)
     else:
         deadline = 1500.0
@@ -1178,6 +1198,9 @@ def main():
     enable_compilation_cache()
     if mode == "mfu":
         bench_mfu(mode_arg)
+        return
+    if mode == "mfu-wide":
+        bench_mfu(mode_arg, compact=False)
         return
     if mode == "mfu-all2all":
         bench_mfu(mode_arg, variant="all2all")

@@ -51,12 +51,14 @@ run_mode() {  # run_mode [bench args...]
         $((d + 1350)) python bench.py "$@"
 }
 # --- still missing a genuine TPU row, cheapest first ---
-# Round-4 MFU attack rows FIRST: bench_mfu's config changed in round 4
-# (eval amortized via eval_every=5 + the einsum conv impl on TPU), so these
-# are NEW measurements, not reruns — the r3 row (0.0039, eval_every=1,
-# grouped-conv lowering) is a different program and any delta vs it is the
-# round-4 work, not run-to-run variance.
+# MFU attack rows FIRST: bench_mfu's config changed again in round 5
+# (compact_deliver default-on; round 4 added eval_every=5 + einsum convs),
+# so these are NEW measurements, not reruns — the r3 row (0.0039,
+# eval_every=1, grouped-conv, full-width passes) is a different program.
+# --mfu-wide is the same round-5 program with compaction off: the pair is
+# the on-chip A/B for the compaction win (CPU A/B: 3.25x).
 run_mode --mfu 50
+run_mode --mfu-wide 50
 run_mode --mfu-all2all 50          # the one-einsum-merge MFU upper end
 run_mode --ring-attn 8192          # flash kernel vs XLA dense attention
 # Phase attribution for the MFU attack (VERDICT #1); rows are self-labeled.

@@ -32,6 +32,31 @@ def test_scale_small_n_keeps_fractional_split(bench, capfd):
 
 
 @pytest.mark.slow
+def test_mfu_wide_json_contract(bench, capfd, monkeypatch):
+    """--mfu-wide (the compaction-off A/B control) emits its own metric
+    name AND actually reaches the simulator with compact_deliver=False —
+    at the smoke N the auto default is also off, so the wiring is
+    asserted at the constructor, not via the (vacuous) derived cap."""
+    import gossipy_tpu.simulation as sim_mod
+    seen = []
+    orig = sim_mod.GossipSimulator
+
+    class Spy(orig):
+        def __init__(self, *a, **kw):
+            seen.append(kw.get("compact_deliver", "MISSING"))
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(sim_mod, "GossipSimulator", Spy)
+    monkeypatch.setattr(bench, "DEGRADED", True)
+    bench.bench_mfu(rounds=1, n_nodes=4, n_train=64, n_test=32,
+                    compact=False)
+    row = last_json(capfd)
+    assert row["metric"] == "mfu_cifar10_100nodes_cnn_widepass"
+    assert row["raw"]["compact_cap"] is None
+    assert seen and all(v is False for v in seen), seen
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("variant,metric", [
     ("vanilla", "mfu_cifar10_100nodes_cnn"),
     ("all2all", "mfu_cifar10_100nodes_cnn_all2all"),
