@@ -1,0 +1,116 @@
+"""Audit the bulk engine's fidelity divergences on YOUR config.
+
+Runs the same small configuration through the jitted bulk-synchronous
+engine and the opt-in sequential high-fidelity engine
+(:class:`~gossipy_tpu.simulation.SequentialGossipSimulator` — reference
+per-tick semantics: in-round snapshots, same-tick token reactions,
+per-message observer events) over a few seeds each, and reports where
+the mean accuracy and send-count curves diverge. This is the workflow
+PARITY.md's divergence table prescribes before trusting a bulk-engine
+study on a new protocol configuration: if the two engines agree on your
+config, the bulk engine's compiled scans are safe at any scale; if not,
+the printed per-round gaps show which transient to mind.
+
+Usage (repo root):
+    python examples/audit_fidelity.py --nodes 16 --rounds 12 --seeds 3
+    python examples/audit_fidelity.py --tokenized   # same-tick reactions
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from _common import make_parser
+
+import jax
+import optax
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.flow_control import SimpleTokenAccount
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import GossipSimulator, \
+    SequentialGossipSimulator, TokenizedGossipSimulator
+
+
+def main() -> None:
+    p = make_parser(__doc__.splitlines()[0], rounds=12, nodes=16,
+                    with_plot=False)
+    p.add_argument("--seeds", type=int, default=3)
+    p.add_argument("--tokenized", action="store_true",
+                   help="audit the token-reaction path (same-tick vs "
+                        "next-round delivery)")
+    args = p.parse_args()
+
+    rng = np.random.default_rng(args.seed)
+    d = 12
+    X = rng.normal(size=(30 * args.nodes, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=args.seed)
+    disp = DataDispatcher(dh, n=args.nodes, eval_on_user=False)
+    topo = Topology.random_regular(args.nodes, min(6, args.nodes - 1),
+                                   seed=args.seed)
+
+    def handler():
+        return SGDHandler(model=LogisticRegression(d, 2),
+                          loss=losses.cross_entropy,
+                          optimizer=optax.sgd(0.2), local_epochs=1,
+                          batch_size=8, n_classes=2, input_shape=(d,),
+                          create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+    def run(engine: str, seed: int):
+        key = jax.random.PRNGKey(seed)
+        if engine == "sequential":
+            kw = ({"token_account": SimpleTokenAccount(C=2)}
+                  if args.tokenized else {})
+            sim = SequentialGossipSimulator(
+                handler(), topo, disp.stacked(), delta=20,
+                protocol=AntiEntropyProtocol.PUSH, **kw)
+        elif args.tokenized:
+            sim = TokenizedGossipSimulator(
+                handler(), topo, disp.stacked(), delta=20,
+                protocol=AntiEntropyProtocol.PUSH,
+                token_account=SimpleTokenAccount(C=2))
+        else:
+            sim = GossipSimulator(handler(), topo, disp.stacked(), delta=20,
+                                  protocol=AntiEntropyProtocol.PUSH)
+        st = sim.init_nodes(key)
+        _, rep = sim.start(st, n_rounds=args.rounds,
+                           key=jax.random.fold_in(key, 1))
+        return (rep.curves(local=False)["accuracy"],
+                np.asarray(rep.sent_per_round, np.float64))
+
+    acc = {"bulk": [], "sequential": []}
+    sent = {"bulk": [], "sequential": []}
+    for engine in ("bulk", "sequential"):
+        for s in range(args.seeds):
+            a, m = run(engine, args.seed + s)
+            acc[engine].append(a)
+            sent[engine].append(m)
+
+    acc_gap = np.abs(np.mean(acc["bulk"], 0) - np.mean(acc["sequential"], 0))
+    sent_gap = np.abs(np.mean(sent["bulk"], 0)
+                      - np.mean(sent["sequential"], 0))
+    print("per-round mean accuracy gap:", np.round(acc_gap, 4).tolist())
+    print("per-round mean sent-count gap:", np.round(sent_gap, 2).tolist())
+    print(json.dumps({
+        "rounds": args.rounds,
+        "nodes": args.nodes,
+        "seeds": args.seeds,
+        "tokenized": bool(args.tokenized),
+        "max_accuracy_gap": round(float(acc_gap.max()), 4),
+        "tail_accuracy_gap": round(float(acc_gap[-1]), 4),
+        "max_sent_gap": round(float(sent_gap.max()), 2),
+        "final": {
+            "accuracy_bulk": round(float(np.mean(acc["bulk"], 0)[-1]), 4),
+            "accuracy_sequential": round(
+                float(np.mean(acc["sequential"], 0)[-1]), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -183,8 +183,24 @@ def _handler(cfg: "ExperimentConfig", model, input_shape, n_classes,
     return cls(**common, **params)
 
 
-def _simulator(cfg: "ExperimentConfig", handler, topology, data):
+def _token_account(cfg: "ExperimentConfig"):
+    """The configured token-account instance (default kind: simple)."""
     from . import flow_control
+    accounts = {
+        "purely_proactive": flow_control.PurelyProactiveTokenAccount,
+        "purely_reactive": flow_control.PurelyReactiveTokenAccount,
+        "simple": flow_control.SimpleTokenAccount,
+        "generalized": flow_control.GeneralizedTokenAccount,
+        "randomized": flow_control.RandomizedTokenAccount,
+    }
+    acc_kind = cfg.token_account or "simple"
+    if acc_kind not in accounts:
+        raise ValueError(f"unknown token account {acc_kind!r}; "
+                         f"options: {sorted(accounts)}")
+    return accounts[acc_kind](**cfg.token_account_params)
+
+
+def _simulator(cfg: "ExperimentConfig", handler, topology, data):
     from .simulation import (
         All2AllGossipSimulator,
         CacheNeighGossipSimulator,
@@ -193,6 +209,7 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
         PassThroughGossipSimulator,
         PENSGossipSimulator,
         SamplingGossipSimulator,
+        SequentialGossipSimulator,
         TokenizedGossipSimulator,
         TokenizedPartitioningGossipSimulator,
     )
@@ -209,19 +226,19 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
     kind = cfg.simulator
     if kind == "gossip":
         return GossipSimulator(handler, topology, data, **common)
+    if kind == "sequential":
+        # The opt-in high-fidelity mode (simulation/sequential.py):
+        # reference per-tick semantics, per-round evaluation only.
+        ev = common.pop("eval_every", 1)
+        if ev != 1:
+            raise ValueError(
+                "the sequential simulator evaluates every round "
+                "(reference tick-loop semantics); eval_every must be 1")
+        account = _token_account(cfg) if cfg.token_account else None
+        return SequentialGossipSimulator(handler, topology, data,
+                                         token_account=account, **common)
     if kind in ("tokenized", "tokenized_partitioning"):
-        accounts = {
-            "purely_proactive": flow_control.PurelyProactiveTokenAccount,
-            "purely_reactive": flow_control.PurelyReactiveTokenAccount,
-            "simple": flow_control.SimpleTokenAccount,
-            "generalized": flow_control.GeneralizedTokenAccount,
-            "randomized": flow_control.RandomizedTokenAccount,
-        }
-        acc_kind = cfg.token_account or "simple"
-        if acc_kind not in accounts:
-            raise ValueError(f"unknown token account {acc_kind!r}; "
-                             f"options: {sorted(accounts)}")
-        account = accounts[acc_kind](**cfg.token_account_params)
+        account = _token_account(cfg)
         sim_cls = (TokenizedPartitioningGossipSimulator
                    if kind == "tokenized_partitioning"
                    else TokenizedGossipSimulator)
@@ -246,7 +263,7 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
     if kind not in simple:
         raise ValueError(
             f"unknown simulator {kind!r}; options: "
-            f"{sorted(simple) + ['gossip', 'tokenized', 'all2all', 'tokenized_partitioning']}")
+            f"{sorted(simple) + ['gossip', 'sequential', 'tokenized', 'all2all', 'tokenized_partitioning']}")
     return simple[kind](handler, topology, data, **common)
 
 
@@ -296,8 +313,15 @@ class ExperimentConfig:
     topology_backend: str = "networkx"
     sparse_topology: bool = False
     # protocol / timing / faults
-    simulator: str = "gossip"
+    simulator: str = "gossip"            # gossip | sequential (high-fidelity
+                                         # eager mode) | tokenized |
+                                         # tokenized_partitioning | all2all |
+                                         # passthrough | cache_neigh |
+                                         # sampling | partitioning | pens
     simulator_params: dict = dataclasses.field(default_factory=dict)
+                                         # extra constructor kwargs (e.g.
+                                         # compact_deliver, mailbox_slots,
+                                         # fused_merge, mixing)
     protocol: str = "PUSH"
     delta: int = 100
     delay: str = "constant"
@@ -378,8 +402,9 @@ def build_experiment(cfg: ExperimentConfig,
         load_recsys_dataset,
     )
 
-    known = {"gossip", "tokenized", "tokenized_partitioning", "all2all",
-             "passthrough", "cache_neigh", "sampling", "partitioning", "pens"}
+    known = {"gossip", "sequential", "tokenized", "tokenized_partitioning",
+             "all2all", "passthrough", "cache_neigh", "sampling",
+             "partitioning", "pens"}
     if cfg.simulator not in known:
         # Cheap name check up front: a typo should not first surface as a
         # topology/model construction error.
@@ -512,10 +537,14 @@ def run_experiment(cfg: ExperimentConfig, data: Optional[tuple] = None):
     """Build and run the experiment.
 
     Returns ``(state, SimulationReport)``; with ``cfg.repetitions > 1``
-    returns ``(stacked_states, [SimulationReport])`` — the whole seed batch
-    executes as one vmapped program (:meth:`GossipSimulator.run_repetitions`),
-    which is what :func:`gossipy_tpu.utils.plot_evaluation`'s mean±std
-    curves consume.
+    returns ``(states, [SimulationReport])`` — on the bulk engines the
+    whole seed batch executes as one vmapped program
+    (:meth:`GossipSimulator.run_repetitions`; ``states`` is a stacked
+    pytree with a leading seed axis), while ``simulator="sequential"``
+    loops seeds eagerly and returns a plain list of
+    :class:`~gossipy_tpu.simulation.SeqState`. The report lists feed
+    :func:`gossipy_tpu.utils.plot_evaluation`'s mean±std curves either
+    way.
     """
     import jax
 

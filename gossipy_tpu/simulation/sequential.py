@@ -384,6 +384,25 @@ class SequentialGossipSimulator(SimulationEventSender):
             "local": local_rows, "global": global_rows}, names)
         return state, report
 
+    def run_repetitions(self, n_rounds: int, keys,
+                        local_train: bool = True,
+                        common_init: bool = False):
+        """API parity with :meth:`GossipSimulator.run_repetitions`: one run
+        per seed. Eager mode has no seed-vmap to exploit, so repetitions
+        execute sequentially (this is the verification engine — use the
+        bulk engine for multi-seed studies at speed). Returns
+        ``(list of final SeqStates, [SimulationReport])``."""
+        states, reports = [], []
+        for key in keys:
+            k_init, k_run = jax.random.split(key)
+            st = self.init_nodes(k_init, local_train=local_train,
+                                 common_init=common_init)
+            st, rep = self.start(st, n_rounds=n_rounds,
+                                 key=jax.random.fold_in(k_run, 2))
+            states.append(st)
+            reports.append(rep)
+        return states, reports
+
     def _fire_message(self, failed: bool, rec: MessageRecord) -> None:
         for rx in self._receivers_list():
             fn = getattr(rx, "update_single_message", None)

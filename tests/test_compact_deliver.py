@@ -115,6 +115,44 @@ class TestCompactRepetitions:
                                        atol=1e-6)
 
 
+class TestCompactSharded:
+    def test_sharded_matches_unsharded(self, key):
+        # The compacted path's argsort/gather/scatter must compile and run
+        # under a node-sharded mesh (the driver's dryrun config) and give
+        # the unsharded trajectory.
+        import jax
+        from gossipy_tpu.parallel import make_mesh, shard_data, shard_state
+        n = 64
+        rng = np.random.default_rng(5)
+        d = 10
+        X = rng.normal(size=(n * 8, d)).astype(np.float32)
+        y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=n)
+        topo = Topology.random_regular(n, 6, seed=0)
+
+        def handler():
+            return SGDHandler(model=LogisticRegression(d, 2),
+                              loss=losses.cross_entropy,
+                              optimizer=optax.sgd(0.5), local_epochs=1,
+                              batch_size=8, n_classes=2, input_shape=(d,),
+                              create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+        mesh = make_mesh()
+        sim = GossipSimulator(handler(), topo,
+                              shard_data(disp.stacked(), mesh), delta=8)
+        assert sim._compact_cap is not None  # auto-on at N=64
+        st = shard_state(sim.init_nodes(key), mesh)
+        _, rep = sim.start(st, n_rounds=3, key=jax.random.fold_in(key, 1))
+        sim_u = GossipSimulator(handler(), topo, disp.stacked(), delta=8)
+        st_u = sim_u.init_nodes(key)
+        _, rep_u = sim_u.start(st_u, n_rounds=3,
+                               key=jax.random.fold_in(key, 1))
+        np.testing.assert_allclose(rep.curves(local=False)["accuracy"],
+                                   rep_u.curves(local=False)["accuracy"],
+                                   atol=1e-5)
+
+
 class TestCompactGating:
     def test_auto_off_below_population_floor(self, key):
         assert make_sim(None)._compact_cap is None  # 16 < 48

@@ -93,6 +93,35 @@ class TestRun:
         state, report = run_experiment(tiny_cfg(), data=tiny_data())
         assert report.curves(local=False)["accuracy"][-1] > 0.8
 
+    def test_run_sequential_simulator(self):
+        # The opt-in high-fidelity engine is config-reachable; with a token
+        # account it runs the same-tick reactive path.
+        state, report = run_experiment(
+            tiny_cfg(simulator="sequential", n_rounds=5,
+                     token_account="simple", token_account_params={"C": 2}),
+            data=tiny_data())
+        acc = report.curves(local=False)["accuracy"]
+        assert np.isfinite(acc).all() and len(acc) == 5
+
+    def test_sequential_rejects_eval_every(self):
+        with pytest.raises(ValueError, match="eval_every"):
+            build_experiment(tiny_cfg(simulator="sequential", eval_every=3),
+                             data=tiny_data())
+
+    def test_sequential_repetitions(self):
+        states, reports = run_experiment(
+            tiny_cfg(simulator="sequential", n_rounds=3, repetitions=2),
+            data=tiny_data())
+        assert len(reports) == 2
+        for r in reports:
+            assert np.isfinite(r.curves(local=False)["accuracy"]).all()
+
+    def test_compact_deliver_via_simulator_params(self):
+        sim, _ = build_experiment(
+            tiny_cfg(simulator_params={"compact_deliver": 4}),
+            data=tiny_data())
+        assert sim._compact_cap == 4
+
     def test_run_from_json_reproducible(self, tmp_path):
         cfg = tiny_cfg()
         p = tmp_path / "exp.json"

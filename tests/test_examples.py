@@ -33,6 +33,9 @@ CASES = [
     ("main_onoszko_2021.py",
      ["--nodes", "4", "--rounds", "1", "--subsample", "100",
       "--step1-rounds", "1"]),
+    # Round-5: the bulk-vs-sequential fidelity audit workflow.
+    ("audit_fidelity.py",
+     ["--nodes", "8", "--rounds", "3", "--seeds", "1", "--tokenized"]),
 ]
 
 
