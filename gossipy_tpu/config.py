@@ -554,6 +554,7 @@ def run_experiment(cfg: ExperimentConfig, data: Optional[tuple] = None):
     sim, _ = build_experiment(cfg, data)
     if cfg.repetitions > 1:
         keys = jax.random.split(key, cfg.repetitions)
-        return sim.run_repetitions(cfg.n_rounds, keys)
+        return sim.run_repetitions(cfg.n_rounds, keys,
+                                   common_init=cfg.common_init)
     state = sim.init_nodes(key, common_init=cfg.common_init)
     return sim.start(state, n_rounds=cfg.n_rounds, key=key)

@@ -59,6 +59,7 @@ run_mode() {  # run_mode [bench args...]
 # the on-chip A/B for the compaction win (CPU A/B: 3.25x).
 run_mode --mfu 50
 run_mode --mfu-wide 50
+run_mode --mfu-reps 8              # seed-batched throughput (MXU-filling)
 run_mode --mfu-all2all 50          # the one-einsum-merge MFU upper end
 run_mode --ring-attn 8192          # flash kernel vs XLA dense attention
 # Phase attribution for the MFU attack (VERDICT #1); rows are self-labeled.

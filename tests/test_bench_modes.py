@@ -57,6 +57,20 @@ def test_mfu_wide_json_contract(bench, capfd, monkeypatch):
 
 
 @pytest.mark.slow
+def test_mfu_reps_json_contract(bench, capfd, monkeypatch):
+    """--mfu-reps (seed-batched throughput): metric suffix, seed_batch
+    field, and executed FLOPs scaled by the batch."""
+    monkeypatch.setattr(bench, "DEGRADED", True)
+    bench.bench_mfu(rounds=1, n_nodes=4, n_train=64, n_test=32, reps=2)
+    row = last_json(capfd)
+    assert row["metric"] == "mfu_cifar10_100nodes_cnn_reps2"
+    raw = row["raw"]
+    assert raw["seed_batch"] == 2
+    assert raw["xla_flops_executed_total"] == \
+        pytest.approx(2 * raw["xla_flops_per_round_with_eval"])
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("variant,metric", [
     ("vanilla", "mfu_cifar10_100nodes_cnn"),
     ("all2all", "mfu_cifar10_100nodes_cnn_all2all"),
