@@ -274,18 +274,20 @@ class SequentialGossipSimulator(SimulationEventSender):
             q = rep_q if is_reply else msg_q
             q.setdefault(t + d, []).append(_Pending(rec, payload, is_reply))
 
+        msg_type = PROTO_TO_MSG[self.protocol]
+        is_pull = self.protocol == AntiEntropyProtocol.PULL
+        send_size = 1 if is_pull else self._size  # PULL requests carry no model
+
         def send_from(i: int, t: int, r: int):
             nbrs = self._nbrs[i]
             if len(nbrs) == 0:
                 return  # isolated node: skip (reference `break` aborts the
                         # whole sweep, simul.py:398-399 — a bug)
             peer = int(nbrs[rng.integers(len(nbrs))])
-            mt = PROTO_TO_MSG[self.protocol]
-            size = 1 if self.protocol == AntiEntropyProtocol.PULL \
-                else self._size
-            payload = None if self.protocol == AntiEntropyProtocol.PULL \
+            payload = None if is_pull \
                 else self.handler.peer_view(state.models[i])
-            schedule(MessageRecord(t, r, i, peer, mt, size), payload, t)
+            schedule(MessageRecord(t, r, i, peer, msg_type, send_size),
+                     payload, t)
 
         def receive(p: _Pending, t: int, r: int, is_online) -> None:
             i = p.rec.receiver
@@ -347,24 +349,22 @@ class SequentialGossipSimulator(SimulationEventSender):
                         state.balance[int(i)] += 1  # bank a token
                         continue
                 send_from(int(i), t, r)
-            # (b) arrival drain — reads the LIVE queue so a zero-delay
-            # reaction scheduled mid-drain is delivered this same tick and
-            # can cascade (the reference appends to the list it iterates).
+            # (b) arrival drain, then (c) reply drain — each reads its LIVE
+            # queue list so a zero-delay reply/reaction scheduled mid-drain
+            # is delivered this same tick and can cascade (the reference
+            # appends to the list it iterates).
             is_online = rng.random(n) <= self.online_prob
-            arrivals = msg_q.get(t, [])
-            idx = 0
-            while idx < len(arrivals):
-                receive(arrivals[idx], t, r, is_online)
-                idx += 1
-            msg_q.pop(t, None)
-            # (c) reply drain (zero-delay replies generated in (b) land
-            # here, same tick — reference rep_queues order).
-            replies = rep_q.get(t, [])
-            idx = 0
-            while idx < len(replies):
-                receive(replies[idx], t, r, is_online)
-                idx += 1
-            rep_q.pop(t, None)
+
+            def drain(q):
+                pending = q.get(t, [])
+                idx = 0
+                while idx < len(pending):
+                    receive(pending[idx], t, r, is_online)
+                    idx += 1
+                q.pop(t, None)
+
+            drain(msg_q)
+            drain(rep_q)
             # (d) round boundary: evaluate + notify.
             if (t + 1) % delta == 0:
                 loc, glob = self._evaluate(state, rng)
@@ -404,10 +404,10 @@ class SequentialGossipSimulator(SimulationEventSender):
         return states, reports
 
     def _fire_message(self, failed: bool, rec: MessageRecord) -> None:
+        # update_single_message is a no-op default on the receiver base
+        # class (events.py) — call it directly, no feature probing.
         for rx in self._receivers_list():
-            fn = getattr(rx, "update_single_message", None)
-            if fn is not None:
-                fn(failed, rec)
+            rx.update_single_message(failed, rec)
 
     def _evaluate(self, state: SeqState, rng):
         names = self._metric_keys()

@@ -266,6 +266,48 @@ class TestProtocolsAndFaults:
         assert len(report.get_evaluation(local=False)) == 5
 
 
+class TestMemoryBudget:
+    def test_terms_and_total(self, key):
+        sim = make_sim()
+        b = sim.memory_budget()
+        # Independent total: name every term explicitly so a term silently
+        # dropping out of (or double-counting into) the engine's own sum
+        # fails here instead of passing a tautological re-sum.
+        assert b["total_bytes"] == (
+            b["model_and_opt_bytes"] + b["history_ring_bytes"]
+            + b["history_ages_bytes"] + b["aux_bytes"]
+            + b["mailbox_bytes"] + b["reply_box_bytes"]
+            + b["data_bytes"] + b["eval_peak_bytes"])
+        # [D, N, K] x 4 int32 fields, mailbox and reply box.
+        assert b["mailbox_bytes"] == 4 * 4 * b["history_depth"] * 16 * sim.K
+        assert b["reply_box_bytes"] == 4 * 4 * b["history_depth"] * 16 * sim.Kr
+        assert b["eval_peak_bytes"] == sim._eval_peak_bytes()
+        assert b["aux_bytes"] == 0  # base engine carries no aux state
+
+    def test_aux_counted_for_variants(self, key):
+        from gossipy_tpu.simulation import CacheNeighGossipSimulator
+        import optax
+        from gossipy_tpu.handlers import SGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        X, y = make_dataset()
+        dh = ClassificationDataHandler(X, y.astype(np.int64), test_size=0.25,
+                                       seed=1)
+        disp = DataDispatcher(dh, n=16)
+        h = SGDHandler(model=LogisticRegression(X.shape[1], 2),
+                       loss=losses.cross_entropy, optimizer=optax.sgd(0.1),
+                       local_epochs=1, batch_size=16, n_classes=2,
+                       input_shape=(X.shape[1],),
+                       create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = CacheNeighGossipSimulator(h, Topology.random_regular(16, 6,
+                                                                   seed=3),
+                                        disp.stacked(), delta=20)
+        b = sim.memory_budget()
+        # CacheNeigh parks up to max_deg model copies per node: the aux
+        # term must exceed the model term by roughly the degree factor.
+        assert b["aux_bytes"] is not None
+        assert b["aux_bytes"] > 2 * b["model_and_opt_bytes"]
+
+
 class TestMessageAccounting:
     def test_sizes_accumulate(self, key):
         sim = make_sim()
