@@ -363,7 +363,8 @@ class GossipSimulator(SimulationEventSender):
             # The fused kernel replaces the whole gather->decode->apply slot
             # pipeline; any variant customizing one of those hooks would be
             # silently bypassed.
-            for hook in ("_apply_receive", "_gather_peer", "_decode_extra"):
+            for hook in ("_apply_receive", "_receive_rows", "_gather_peer",
+                         "_decode_extra"):
                 assert getattr(type(self), hook) is getattr(GossipSimulator, hook), \
                     f"fused_merge requires the base receive path ({hook} is " \
                     f"overridden by {type(self).__name__})"
@@ -375,11 +376,12 @@ class GossipSimulator(SimulationEventSender):
 
         # Compaction re-routes the gather->decode->apply slot pipeline
         # through [cap]-shaped sub-batches; like fused_merge it is only
-        # valid when the pipeline pieces are the base ones (_decode_extra
-        # overrides ARE supported — the decoded arg is gathered — because
-        # every in-tree override is elementwise and the contract is
-        # documented; _gather_peer/_apply_receive overrides may read
-        # full-width positional state and are not).
+        # valid when the pipeline pieces are the base ones. Supported
+        # customization points under compaction: _decode_extra (the
+        # decoded arg is gathered; every in-tree override is elementwise)
+        # and _receive_rows (row-aligned by contract). _gather_peer /
+        # _apply_receive overrides may read full-width positional state
+        # and disable it.
         base_receive = all(
             getattr(type(self), hook) is getattr(GossipSimulator, hook)
             for hook in ("_apply_receive", "_gather_peer"))
@@ -420,29 +422,40 @@ class GossipSimulator(SimulationEventSender):
             self._compact_cap = None
         else:
             self._compact_cap = (
-                self._derive_compact_cap(self._lam_max()) if compact_deliver
+                self._derive_compact_cap() if compact_deliver
                 else None)
 
     # -- setup -------------------------------------------------------------
 
     def _lam_max(self) -> float:
-        """``_max_expected_fanin`` computed at most once per simulator —
-        the scan is O(E) (or an [N, N] matvec on dense topologies) and both
-        consumers (slot derivation + undersized warning) may want it.
-        Subclasses whose round never reads the mailbox (All2All) pin
-        ``mailbox_slots`` and no-op the warning, skipping the scan
-        entirely."""
+        """Worst-case expected fan-in, computed at most once per simulator —
+        the scan is O(E) (or an [N, N] matvec on dense topologies) and all
+        consumers (slot derivation, compaction capacity, undersized
+        warning) share it. Subclasses whose round never reads the mailbox
+        (All2All) pin ``mailbox_slots`` and no-op the warning, skipping
+        the scan entirely."""
         if self._lam_max_cache is None:
-            self._lam_max_cache = self._max_expected_fanin()
+            self._lam_max_cache = float(self._lam_vector().max()) \
+                if self.n_nodes else 0.0
         return self._lam_max_cache
 
-    def _max_expected_fanin(self) -> float:
-        """Worst-case expected same-round fan-in under uniform peer
-        sampling: ``max_i sum_{j in N(i)} F / deg_j`` (delays spreading
-        arrivals across rounds make this an upper-ish estimate; replies add
-        ~the same again for PUSH_PULL)."""
+    def _lam_vector(self) -> np.ndarray:
+        """Cached :meth:`_expected_fanin_vector` — both consumers (mailbox
+        bound via max, compaction capacity via the sum of per-node tails)
+        run at construction and must not pay the O(E)/matvec scan twice."""
+        if getattr(self, "_lam_vec_cache", None) is None:
+            self._lam_vec_cache = self._expected_fanin_vector()
+        return self._lam_vec_cache
+
+    def _expected_fanin_vector(self) -> np.ndarray:
+        """Per-node expected same-round fan-in under uniform peer sampling:
+        ``lam_i = sum_{j in N(i)} F / deg_j`` (delays spreading arrivals
+        across rounds make this an upper-ish estimate; replies add ~the
+        same again for PUSH_PULL). Max drives the mailbox bound; the full
+        vector drives the compaction capacity — on hub topologies the max
+        (the hub) says nothing about how many NODES see multi-arrivals."""
         if self.n_nodes == 0:
-            return 0.0
+            return np.zeros(0)
         deg = np.maximum(np.asarray(self.topology.degrees, dtype=np.float64), 1.0)
         inv = self.F / deg  # per-sender hit probability on each out-neighbor
         try:
@@ -453,14 +466,14 @@ class GossipSimulator(SimulationEventSender):
             # Fan-in of i = sum over SENDERS j (adj[j, i]) of F/deg_j — a
             # column sum (adjacency rows are out-neighbors; directed
             # adjacencies are allowed).
-            return float((inv @ adj).max())
+            return np.asarray(inv @ adj, dtype=np.float64)
         # CSR rows are out-neighbor lists: scatter each sender row's
         # F/deg into its targets.
         lam = np.zeros(self.n_nodes)
         degrees = np.asarray(self.topology.degrees)
         if degrees.sum():
             np.add.at(lam, self.topology.indices, np.repeat(inv, degrees))
-        return float(lam.max())
+        return lam
 
     @staticmethod
     def _poisson_tail(lam: float, k: int) -> float:
@@ -493,19 +506,26 @@ class GossipSimulator(SimulationEventSender):
             k += 1
         return k
 
-    def _derive_compact_cap(self, lam_max: float) -> Optional[int]:
+    def _derive_compact_cap(self) -> Optional[int]:
         """Static receiver capacity for the compacted slot pass.
 
         Sized for slots >= 1 (the waste-dominated ones): the number of
-        nodes with a second same-round arrival is ~Binomial(N, P(X >= 2))
-        at the worst node's Poisson fan-in; take mean + 3 sigma + 4, round
-        up to a multiple of 8 (tidy vector lanes). Slot 0 (~``1-e^-lam`` of
-        the population) intentionally overflows the capacity and takes the
-        full-width pass. Returns None when the capacity would not beat the
-        full pass (compaction then stays off)."""
+        nodes with a second same-round arrival is a sum of independent
+        per-node indicators with ``p2_i = P(Poisson(lam_i) >= 2)`` at each
+        node's OWN expected fan-in; take mean + 3 sigma + 4, round up to a
+        multiple of 8 (tidy vector lanes). Per-node (not worst-case)
+        probabilities matter on hub topologies: a BA hub's lam is huge but
+        it is ONE node — sizing from the max would disable compaction for
+        the whole population. Slot 0 (~``sum(1-e^-lam_i)`` nodes)
+        intentionally overflows the capacity and takes the full-width
+        pass. Returns None when the capacity would not beat the full pass
+        (compaction then stays off)."""
         n = self.n_nodes
-        p2 = self._poisson_tail(lam_max, 1)  # P(arrivals >= 2)
-        cap = n * p2 + 3.0 * float(np.sqrt(n * p2 * (1.0 - p2))) + 4.0
+        lam = self._lam_vector()
+        # 1 - e^-lam (1 + lam), elementwise and vectorized (the loop-free
+        # float64 form is stable here: no cumprod, no division).
+        p2 = np.clip(-np.expm1(-lam) - lam * np.exp(-lam), 0.0, 1.0)
+        cap = p2.sum() + 3.0 * float(np.sqrt((p2 * (1.0 - p2)).sum())) + 4.0
         cap = int(-(-cap // 8) * 8)
         cap = max(cap, 8)
         if cap >= 0.75 * n:
@@ -878,10 +898,8 @@ class GossipSimulator(SimulationEventSender):
         extra_arg = self._decode_extra(extra)
         if extra_arg is not None:
             extra_arg = jax.tree.map(take, extra_arg)
-        new_sub = jax.vmap(
-            self.handler.call,
-            in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
-            )(sub_model, peer, data, keys, extra_arg)
+        new_sub = self._receive_rows(sub_model, peer, data, keys, extra_arg,
+                                     idx)
         new_sub = select_nodes(sub_valid, new_sub, sub_model)
         model = jax.tree.map(
             lambda full, part: (full.at[idx].set(part)
@@ -889,15 +907,34 @@ class GossipSimulator(SimulationEventSender):
             state.model, new_sub)
         return state._replace(model=model)
 
+    def _receive_rows(self, models: ModelState, peer: PeerModel, data,
+                      keys, extra_arg, node_ids) -> ModelState:
+        """The per-row receive computation (one mailbox slot's live rows).
+
+        Every argument is ROW-ALIGNED: the full population for the wide
+        pass, a gathered subset for the compacted pass; ``node_ids`` maps
+        rows back to node indices (``arange(N)`` when wide). Variants that
+        customize receive behavior should override THIS (not
+        ``_apply_receive``) to stay compaction-compatible — the contract
+        is: read per-node state by ``node_ids`` (never positionally by
+        row), and derive any extra randomness from the per-row ``keys``
+        (e.g. ``fold_in(keys[i], tag)``), never from a population-shaped
+        draw.
+        """
+        return jax.vmap(
+            self.handler.call,
+            in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
+            )(models, peer, data, keys, extra_arg)
+
     def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
                        call_key) -> SimState:
-        """Vmapped ``handler.call`` masked by ``valid`` (one mailbox slot)."""
+        """Full-width ``_receive_rows`` masked by ``valid`` (one slot)."""
         data = self._local_data()
         keys = jax.random.split(call_key, self.n_nodes)
         extra_arg = self._decode_extra(extra)
-        new_model = jax.vmap(self.handler.call,
-                             in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
-                             )(state.model, peer, data, keys, extra_arg)
+        new_model = self._receive_rows(state.model, peer, data, keys,
+                                       extra_arg,
+                                       jnp.arange(self.n_nodes))
         return state._replace(model=select_nodes(valid, new_model, state.model))
 
     def _fused_receive(self, state: SimState, send_round, sender, valid,

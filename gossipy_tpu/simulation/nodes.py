@@ -77,21 +77,25 @@ class PassThroughGossipSimulator(GossipSimulator):
     def _reply_extra(self, key, state):
         return self.topology.degrees_dev.astype(jnp.int32)
 
-    def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
-                       call_key) -> SimState:
-        deg_self = jnp.maximum(self.topology.degrees_dev.astype(jnp.float32), 1.0)
-        deg_send = extra.astype(jnp.float32)
-        p = jnp.minimum(1.0, deg_send / deg_self)
-        accept = jax.random.bernoulli(jax.random.fold_in(call_key, 911), p)
+    def _decode_extra(self, extra):
+        return extra  # the sender's degree, raw
 
-        data = self._local_data()
-        keys = jax.random.split(call_key, self.n_nodes)
-        normal = jax.vmap(self.handler.call, in_axes=(0, 0, 0, 0, None))(
-            state.model, peer, data, keys, None)
+    def _receive_rows(self, models, peer, data, keys, extra_arg, node_ids):
+        """Row-aligned receive (engine contract: compaction-compatible) —
+        per-row accept draw keyed on the row's PRNG stream, receiver
+        degree gathered by ``node_ids``."""
+        deg_self = jnp.maximum(
+            self.topology.degrees_dev[node_ids].astype(jnp.float32), 1.0)
+        deg_send = extra_arg.astype(jnp.float32)
+        p = jnp.minimum(1.0, deg_send / deg_self)
+        accept = jax.vmap(
+            lambda k, pi: jax.random.bernoulli(jax.random.fold_in(k, 911),
+                                               pi))(keys, p)
+        normal = super()._receive_rows(models, peer, data, keys, None,
+                                       node_ids)
         # PASS: adopt the received model as-is (node.py:381-386).
-        passed = ModelState(peer.params, state.model.opt_state, peer.n_updates)
-        chosen = select_nodes(accept, normal, passed)
-        return state._replace(model=select_nodes(valid, chosen, state.model))
+        passed = ModelState(peer.params, models.opt_state, peer.n_updates)
+        return select_nodes(accept, normal, passed)
 
 
 class SamplingGossipSimulator(GossipSimulator):

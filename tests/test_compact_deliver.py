@@ -20,8 +20,8 @@ from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
 from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
 from gossipy_tpu.handlers import SGDHandler, SamplingSGDHandler, losses
 from gossipy_tpu.models import LogisticRegression
-from gossipy_tpu.simulation import GossipSimulator, \
-    PassThroughGossipSimulator, SamplingGossipSimulator
+from gossipy_tpu.simulation import CacheNeighGossipSimulator, \
+    GossipSimulator, PassThroughGossipSimulator, SamplingGossipSimulator
 
 
 def make_sim(compact, n_nodes=16, protocol=AntiEntropyProtocol.PUSH,
@@ -96,6 +96,13 @@ class TestCompactEquivalence:
         assert_same_trajectory(key, cap=5, sim_cls=SamplingGossipSimulator,
                                handler_cls=SamplingSGDHandler)
 
+    def test_receive_rows_variant(self, key):
+        # PassThrough customizes receive via the row-aligned
+        # _receive_rows contract (per-row accept draw, node_ids-gathered
+        # degrees) — compaction must preserve its trajectory too.
+        assert_same_trajectory(key, cap=5,
+                               sim_cls=PassThroughGossipSimulator)
+
 
 class TestCompactRepetitions:
     def test_run_repetitions_disables_compaction_and_matches(self, key):
@@ -165,12 +172,18 @@ class TestCompactGating:
             make_sim(-2)
 
     def test_variant_override_rejected(self, key):
+        # CacheNeigh overrides _apply_receive (it parks peers in
+        # positional aux slots) — incompatible with compaction.
         with pytest.raises(AssertionError, match="base _apply_receive"):
-            make_sim(True, sim_cls=PassThroughGossipSimulator)
+            make_sim(True, sim_cls=CacheNeighGossipSimulator)
 
     def test_variant_auto_silently_off(self, key):
-        sim = make_sim(None, sim_cls=PassThroughGossipSimulator)
+        # n_nodes=64 clears the population floor, so the ONLY reason
+        # compaction can stay off is the override gate (at the default 16
+        # the size gate would mask a broken variant check).
+        sim = make_sim(None, n_nodes=64, sim_cls=CacheNeighGossipSimulator)
         assert sim._compact_cap is None
+        assert make_sim(None, n_nodes=64)._compact_cap is not None
 
     def test_derived_cap_at_scale(self):
         # At 100 nodes / degree 20 / PUSH the worst-case fan-in is ~1:
