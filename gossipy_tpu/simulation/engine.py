@@ -521,10 +521,20 @@ class GossipSimulator(SimulationEventSender):
         pass. Returns None when the capacity would not beat the full pass
         (compaction then stays off)."""
         n = self.n_nodes
-        lam = self._lam_vector()
+        # The slot pass's LIVE count sees only messages that survived the
+        # drop draw (never scattered) and landed on an online receiver —
+        # both static rates, priced in with their actual runtime shapes:
+        # drops are per-MESSAGE (Poisson thinning of the arrival
+        # intensity), while online is sampled once per RECEIVER-round and
+        # gates all of a node's slots at once (a Bernoulli factor on the
+        # node's live indicator, NOT a thinning of lam). The mailbox
+        # bound deliberately prices neither (staying conservative there
+        # costs slots, not semantics).
+        lam = self._lam_vector() * (1.0 - self.drop_prob)
         # 1 - e^-lam (1 + lam), elementwise and vectorized (the loop-free
         # float64 form is stable here: no cumprod, no division).
         p2 = np.clip(-np.expm1(-lam) - lam * np.exp(-lam), 0.0, 1.0)
+        p2 *= self.online_prob
         cap = p2.sum() + 3.0 * float(np.sqrt((p2 * (1.0 - p2)).sum())) + 4.0
         cap = int(-(-cap // 8) * 8)
         cap = max(cap, 8)

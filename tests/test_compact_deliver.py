@@ -25,11 +25,12 @@ from gossipy_tpu.simulation import CacheNeighGossipSimulator, \
 
 
 def make_sim(compact, n_nodes=16, protocol=AntiEntropyProtocol.PUSH,
-             sim_cls=GossipSimulator, handler_cls=SGDHandler, **sim_kwargs):
+             sim_cls=GossipSimulator, handler_cls=SGDHandler, topology=None,
+             **sim_kwargs):
     rng = np.random.default_rng(3)
     d = 10
     w = rng.normal(size=d)
-    X = rng.normal(size=(320, d)).astype(np.float32)
+    X = rng.normal(size=(20 * n_nodes, d)).astype(np.float32)
     y = (X @ w > 0).astype(np.int64)
     dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
     disp = DataDispatcher(dh, n=n_nodes)
@@ -42,9 +43,10 @@ def make_sim(compact, n_nodes=16, protocol=AntiEntropyProtocol.PUSH,
                           batch_size=16, n_classes=2, input_shape=(d,),
                           create_model_mode=CreateModelMode.MERGE_UPDATE,
                           **kw)
-    return sim_cls(handler, Topology.random_regular(n_nodes, 6, seed=7),
-                   disp.stacked(), delta=20, protocol=protocol,
-                   compact_deliver=compact, **sim_kwargs)
+    if topology is None:
+        topology = Topology.random_regular(n_nodes, 6, seed=7)
+    return sim_cls(handler, topology, disp.stacked(), delta=20,
+                   protocol=protocol, compact_deliver=compact, **sim_kwargs)
 
 
 def run(sim, key, rounds=6):
@@ -192,3 +194,22 @@ class TestCompactGating:
         sim = make_sim(True, n_nodes=100)
         assert sim._compact_cap is not None
         assert 24 <= sim._compact_cap < 75
+
+    def test_hub_topology_still_compacts(self):
+        # The capacity derives from PER-NODE fan-in tails: a BA hub's
+        # enormous lam is one node, not a reason to disable compaction
+        # for the population (the hub's slots overflow to the full pass
+        # at runtime).
+        sim = make_sim(True, n_nodes=64,
+                       topology=Topology.barabasi_albert(64, 3, seed=1))
+        assert sim._compact_cap is not None
+        assert sim._compact_cap < 48  # well under 0.75 * N
+
+    def test_faults_shrink_the_cap(self):
+        # Dropped messages never scatter and offline receivers mask their
+        # slots invalid, so the live count the capacity protects is
+        # statically smaller under faults.
+        healthy = make_sim(True, n_nodes=100)._compact_cap
+        faulty = make_sim(True, n_nodes=100, drop_prob=0.5,
+                          online_prob=0.5)._compact_cap
+        assert faulty is not None and faulty < healthy
