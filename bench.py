@@ -76,6 +76,28 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload))
 
 
+def emit_manifest(sim, mode: str) -> None:
+    """Emit the run's RunManifest JSON: one ``[manifest] {...}`` line on
+    STDERR (the stdout one-line metric contract is untouched) plus an
+    optional file copy at ``$GOSSIPY_TPU_MANIFEST``. Collection is
+    best-effort — a manifest failure must never take down a finished
+    measurement."""
+    try:
+        manifest = sim.run_manifest(extra={"bench_mode": mode})
+        line = manifest.to_json()
+    except Exception as e:
+        print(f"[manifest] collection failed: {e!r}", file=sys.stderr)
+        return
+    print("[manifest] " + line, file=sys.stderr)
+    path = os.environ.get("GOSSIPY_TPU_MANIFEST")
+    if path:
+        try:
+            manifest.save(path)
+        except OSError as e:
+            print(f"[manifest] could not write {path}: {e!r}",
+                  file=sys.stderr)
+
+
 def make_data():
     """Deterministic spambase-shaped dataset (4601 x 57, binary)."""
     from gossipy_tpu.data import load_classification_dataset
@@ -115,7 +137,7 @@ def build_sim(X, y, fused: bool = False):
 def bench_ours(X, y) -> float:
     import jax
 
-    def run(fused: bool) -> tuple[float, float]:
+    def run(fused: bool) -> tuple[float, float, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
         sim = build_sim(X, y, fused)
         key = jax.random.PRNGKey(42)
@@ -127,23 +149,24 @@ def bench_ours(X, y) -> float:
         s3, report = sim.start(state, n_rounds=n_rounds, key=key)
         jax.block_until_ready(s3.model.params)
         elapsed = time.perf_counter() - t0
-        return elapsed, report.curves(local=False)["accuracy"][-1]
+        return elapsed, report.curves(local=False)["accuracy"][-1], sim
 
     n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
-    elapsed, acc = run(False)
+    elapsed, acc, sim = run(False)
     label = "plain"
     if jax.default_backend() == "tpu":
         try:  # pallas fused deliver path: keep whichever is faster on this chip
-            elapsed_f, acc_f = run(True)
+            elapsed_f, acc_f, sim_f = run(True)
             print(f"[bench] fused: {n_rounds} rounds in {elapsed_f:.2f}s",
                   file=sys.stderr)
             if elapsed_f < elapsed:
-                elapsed, acc, label = elapsed_f, acc_f, "fused"
+                elapsed, acc, label, sim = elapsed_f, acc_f, "fused", sim_f
         except Exception as e:  # kernel unavailable on this backend
             print(f"[bench] fused path unavailable ({e!r})", file=sys.stderr)
     print(f"[bench] ours ({label}): {n_rounds} rounds in {elapsed:.2f}s "
           f"({n_rounds/elapsed:.1f} r/s), final global acc {acc:.3f}",
           file=sys.stderr)
+    emit_manifest(sim, f"north-star/{label}")
     return n_rounds / elapsed
 
 
@@ -434,6 +457,7 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
         jax.block_until_ready(s3.model.params)
         elapsed = time.perf_counter() - t0
 
+    emit_manifest(sim, f"mfu/{variant}")
     achieved = flops_total / elapsed if flops_total is not None else None
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind)
@@ -540,6 +564,7 @@ def _scale_harness(n_nodes: int, rounds: int, build_sim):
     jax.block_until_ready(s3.model.params)
     elapsed = time.perf_counter() - t0
     stamp("done")
+    emit_manifest(sim, "scale")
     acc = report.curves(local=False)["accuracy"][-1]
     return rounds / elapsed, float(acc), build_s
 

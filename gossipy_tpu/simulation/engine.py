@@ -49,6 +49,15 @@ import numpy as np
 
 from ..core import AntiEntropyProtocol, ConstantDelay, Delay, MessageType, Topology
 from ..handlers.base import BaseHandler, ModelState, PeerModel
+from ..telemetry import (
+    PHASE_EVAL,
+    PHASE_RECEIVE_MERGE,
+    PHASE_REPLY,
+    PHASE_SEND,
+    PHASE_TRAIN,
+    FailureCounts,
+    emit_event,
+)
 from .events import SimulationEventSender
 from .report import SimulationReport
 
@@ -555,6 +564,13 @@ class GossipSimulator(SimulationEventSender):
         p_over = self._poisson_tail(lam_max, self.K)
         if p_over > 1e-3:
             import warnings
+            emit_event("mailbox_undersized", {
+                "mailbox_slots": self.K,
+                "lam_max": lam_max,
+                "p_overflow_per_node_round": p_over,
+                "n_nodes": self.n_nodes,
+                "simulator": type(self).__name__,
+            })
             warnings.warn(
                 f"mailbox_slots={self.K} may overflow on this topology: "
                 f"worst-case expected same-round fan-in {lam_max:.1f} gives "
@@ -596,6 +612,13 @@ class GossipSimulator(SimulationEventSender):
         est_bytes = self._eval_peak_bytes()
         if est_bytes > 2 << 30:
             import warnings
+            emit_event("eval_memory_large", {
+                "eval_peak_bytes": est_bytes,
+                "n_eval_nodes": n_eval_nodes,
+                "n_eval_samples": n_samples,
+                "sampling_eval": self.sampling_eval,
+                "simulator": type(self).__name__,
+            })
             warnings.warn(
                 f"global evaluation materializes ~[{n_eval_nodes} nodes x "
                 f"{n_samples} samples] intermediates "
@@ -809,7 +832,7 @@ class GossipSimulator(SimulationEventSender):
         msg_type = PROTO_TO_MSG[self.protocol]
 
         n_sent = jnp.int32(0)
-        n_failed = jnp.int32(0)
+        fails = FailureCounts.zeros()
         # Sub-fires: async nodes whose period fits multiple times in the
         # round window send once per multiple (all from the round-start
         # snapshot). F is 1 for sync simulations, so f=0 reproduces the
@@ -835,16 +858,16 @@ class GossipSimulator(SimulationEventSender):
             extra = self._send_extra(key_f(_K_EXTRA), state)
 
             n_sent += active.sum()
-            n_failed += (active & dropped).sum()
+            fails = fails._replace(drop=fails.drop + (active & dropped).sum())
             live = active & ~dropped
             box, n_overflow = self._scatter_messages(
                 state.mailbox, live, dr, peers, jnp.arange(n, dtype=jnp.int32),
                 jnp.broadcast_to(r.astype(jnp.int32), (n,)),
                 jnp.full((n,), int(msg_type), dtype=jnp.int32),
                 extra, r, self.K)
-            n_failed += n_overflow
+            fails = fails._replace(overflow=fails.overflow + n_overflow)
             state = state._replace(mailbox=box)
-        return state, n_sent, n_failed, n_sent * size
+        return state, n_sent, fails, n_sent * size
 
     def _gather_peer(self, state: SimState, send_round, sender):
         """Fetch the snapshot a message carries: history[send_round % D][sender]."""
@@ -931,10 +954,11 @@ class GossipSimulator(SimulationEventSender):
         (e.g. ``fold_in(keys[i], tag)``), never from a population-shaped
         draw.
         """
-        return jax.vmap(
-            self.handler.call,
-            in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
-            )(models, peer, data, keys, extra_arg)
+        with jax.named_scope(PHASE_TRAIN):
+            return jax.vmap(
+                self.handler.call,
+                in_axes=(0, 0, 0, 0, 0 if extra_arg is not None else None)
+                )(models, peer, data, keys, extra_arg)
 
     def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
                        call_key) -> SimState:
@@ -967,13 +991,27 @@ class GossipSimulator(SimulationEventSender):
         merged = ModelState(merged_params, state.model.opt_state,
                             jnp.maximum(state.model.n_updates, peer_ages))
         keys = jax.random.split(call_key, n)
-        updated = jax.vmap(self.handler.update)(merged, self._local_data(), keys)
+        with jax.named_scope(PHASE_TRAIN):
+            updated = jax.vmap(self.handler.update)(merged, self._local_data(),
+                                                    keys)
         return state._replace(model=select_nodes(valid, updated, state.model))
 
     def _decode_extra(self, extra: jax.Array):
         """Map the int32 wire field to the handler's ``extra`` argument.
         Base protocol carries nothing."""
         return None
+
+    def _delivery_path_counts(self, apply_mask):
+        """(compact, wide) 0/1 indicators for one occupied slot's delivery,
+        mirroring :meth:`_receive_slot_apply`'s runtime dispatch predicate
+        exactly (the cond itself cannot thread a counter out, so the
+        indicator is recomputed from the same inputs)."""
+        occupied_slot = apply_mask.any()
+        if self._compact_cap is None:
+            return jnp.int32(0), occupied_slot.astype(jnp.int32)
+        took_compact = occupied_slot & (apply_mask.sum() <= self._compact_cap)
+        return (took_compact.astype(jnp.int32),
+                (occupied_slot & ~took_compact).astype(jnp.int32))
 
     def _deliver_phase(self, state: SimState, base_key, r):
         n = self.n_nodes
@@ -982,6 +1020,12 @@ class GossipSimulator(SimulationEventSender):
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE), self.online_prob, (n,))
         size = self._model_size(state.model.params)
+        # Mailbox occupancy high-water mark of the cell being drained: the
+        # fullest receiver's slot count this round (a per-round headroom
+        # gauge against self.K — the traced counterpart of the
+        # construction-time undersized warning).
+        hwm = (state.mailbox.sender[b] >= 0).sum(axis=1).max() \
+            .astype(jnp.int32)
 
         # One fori_loop iteration per mailbox slot: the compiled program
         # contains ONE copy of the merge+train graph regardless of K (an
@@ -990,20 +1034,25 @@ class GossipSimulator(SimulationEventSender):
         # derivation, dynamic slot reads, and the _post_receive_slot hook —
         # subclass hooks must treat k as an array, not a Python int.
         def slot_body(k, carry):
-            state, n_failed, n_sent_replies, reply_size_total = carry
+            state, fails, n_sent_replies, reply_size_total, \
+                n_compact, n_wide = carry
             sender = jnp.take(state.mailbox.sender[b], k, axis=1)
             sr = jnp.take(state.mailbox.send_round[b], k, axis=1)
             ty = jnp.take(state.mailbox.msg_type[b], k, axis=1)
             extra = jnp.take(state.mailbox.extra[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
-            n_failed += (occupied & ~online).sum()
+            fails = fails._replace(
+                offline=fails.offline + (occupied & ~online).sum())
 
             carries_model = (ty == MessageType.PUSH) | \
                             (ty == MessageType.PUSH_PULL) | \
                             (ty == MessageType.REPLY)
             apply_mask = valid & carries_model
             call_key = self._round_key(base_key, r, _K_CALL * 101 + k)
+            dc, dw = self._delivery_path_counts(apply_mask)
+            n_compact += dc
+            n_wide += dw
             # Higher slots are empty most rounds (at most ~1 push per
             # receiver per round in the base protocol); a cond lets the
             # compiled program skip the whole merge+train pass for an
@@ -1026,7 +1075,8 @@ class GossipSimulator(SimulationEventSender):
                 rdr = rdelay // self.delta
                 n_sent_replies += reply_needed.sum()
                 reply_size_total += reply_needed.sum() * size
-                n_failed += (reply_needed & rdrop).sum()
+                fails = fails._replace(
+                    drop=fails.drop + (reply_needed & rdrop).sum())
                 live = reply_needed & ~rdrop
                 rbox, n_overflow = self._scatter_messages(
                     state.reply_box, live, rdr, sender,
@@ -1036,21 +1086,26 @@ class GossipSimulator(SimulationEventSender):
                     self._reply_extra(
                         self._round_key(base_key, r, (_K_EXTRA + 31) * 101 + k),
                         state), r, self.Kr)
-                n_failed += n_overflow
+                fails = fails._replace(overflow=fails.overflow + n_overflow)
                 state = state._replace(reply_box=rbox)
 
             state = self._post_receive_slot(state, valid, ty, sender, sr,
                                             extra, base_key, r, k)
-            return state, n_failed, n_sent_replies, reply_size_total
+            return state, fails, n_sent_replies, reply_size_total, \
+                n_compact, n_wide
 
-        state, n_failed, n_sent_replies, reply_size_total = jax.lax.fori_loop(
-            0, self.K, slot_body,
-            (state, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+        state, fails, n_sent_replies, reply_size_total, n_compact, n_wide = \
+            jax.lax.fori_loop(
+                0, self.K, slot_body,
+                (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0),
+                 jnp.int32(0), jnp.int32(0)))
 
         state = state._replace(mailbox=state.mailbox.clear_cell(b))
-        state, ex_sent, ex_failed, ex_size = self._post_deliver(state, base_key, r)
-        return state, n_sent_replies + ex_sent, n_failed + ex_failed, \
-            reply_size_total + ex_size
+        state, ex_sent, ex_fails, ex_size = self._post_deliver(state, base_key, r)
+        diag = {"mailbox_hwm": hwm, "compact_slots": n_compact,
+                "wide_slots": n_wide}
+        return state, n_sent_replies + ex_sent, fails + ex_fails, \
+            reply_size_total + ex_size, diag
 
     def _post_receive_slot(self, state: SimState, valid, ty, sender,
                            send_round, extra, base_key, r, k) -> SimState:
@@ -1066,8 +1121,11 @@ class GossipSimulator(SimulationEventSender):
 
     def _post_deliver(self, state: SimState, base_key, r):
         """Hook after the deliver phase; may emit extra messages. Returns
-        (state, n_sent, n_failed, total_size)."""
-        return state, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+        ``(state, n_sent, fails, total_size)`` where ``fails`` is a
+        :class:`~gossipy_tpu.telemetry.FailureCounts` (per-cause traced
+        counters — overriding variants attribute their losses to
+        drop/offline/overflow rather than one opaque sum)."""
+        return state, jnp.int32(0), FailureCounts.zeros(), jnp.int32(0)
 
     def _reply_extra(self, key: jax.Array, state: SimState) -> jax.Array:
         return jnp.zeros(self.n_nodes, dtype=jnp.int32)
@@ -1080,33 +1138,40 @@ class GossipSimulator(SimulationEventSender):
 
     def _reply_phase(self, state: SimState, base_key, r):
         if not self._replies_possible():
-            return state, jnp.int32(0)
+            return state, FailureCounts.zeros(), \
+                {"compact_slots": jnp.int32(0), "wide_slots": jnp.int32(0)}
         n = self.n_nodes
         D = state.history_ages.shape[0]
         b = r % D
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE * 7 + 3), self.online_prob, (n,))
         def slot_body(k, carry):
-            state, n_failed = carry
+            state, fails, n_compact, n_wide = carry
             sender = jnp.take(state.reply_box.sender[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
-            n_failed += (occupied & ~online).sum()
+            fails = fails._replace(
+                offline=fails.offline + (occupied & ~online).sum())
             sr_k = jnp.take(state.reply_box.send_round[b], k, axis=1)
             extra_k = jnp.take(state.reply_box.extra[b], k, axis=1)
             call_key = self._round_key(base_key, r, (_K_CALL + 53) * 101 + k)
+            dc, dw = self._delivery_path_counts(valid)
+            n_compact += dc
+            n_wide += dw
             state = jax.lax.cond(
                 valid.any(),
                 lambda st: self._receive_slot_apply(st, sr_k, sender, extra_k,
                                                     valid, call_key),
                 lambda st: st,
                 state)
-            return state, n_failed
+            return state, fails, n_compact, n_wide
 
-        state, n_failed = jax.lax.fori_loop(
-            0, self.Kr, slot_body, (state, jnp.int32(0)))
+        state, fails, n_compact, n_wide = jax.lax.fori_loop(
+            0, self.Kr, slot_body,
+            (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0)))
         state = state._replace(reply_box=state.reply_box.clear_cell(b))
-        return state, n_failed
+        return state, fails, \
+            {"compact_slots": n_compact, "wide_slots": n_wide}
 
     # -- evaluation ---------------------------------------------------------
 
@@ -1190,16 +1255,36 @@ class GossipSimulator(SimulationEventSender):
 
     def _round(self, state: SimState, base_key: jax.Array, last_round=None):
         r = state.round
-        state = self._pre_send(state, base_key, r)
-        state = self._snapshot(state, r)
-        state, n_sent, n_fail_s, size_s = self._send_phase(state, base_key, r)
-        state, n_replies, n_fail_d, size_r = self._deliver_phase(state, base_key, r)
-        state, n_fail_r = self._reply_phase(state, base_key, r)
-        local, glob = self._maybe_eval(state, base_key, r, last_round)
+        # Phase scopes (telemetry.scopes): the names land in the compiled
+        # HLO's op metadata and in XProf traces captured via profile_dir=,
+        # so a trace shows named phases instead of one opaque scan body.
+        # The train scope nests inside receive_merge/reply around the
+        # vmapped handler pass (_receive_rows / _fused_receive).
+        with jax.named_scope(PHASE_SEND):
+            state = self._pre_send(state, base_key, r)
+            state = self._snapshot(state, r)
+            state, n_sent, fail_s, size_s = self._send_phase(state, base_key, r)
+        with jax.named_scope(PHASE_RECEIVE_MERGE):
+            state, n_replies, fail_d, size_r, diag = \
+                self._deliver_phase(state, base_key, r)
+        with jax.named_scope(PHASE_REPLY):
+            state, fail_r, reply_diag = self._reply_phase(state, base_key, r)
+        with jax.named_scope(PHASE_EVAL):
+            local, glob = self._maybe_eval(state, base_key, r, last_round)
         state = state._replace(round=r + 1)
+        fails = fail_s + fail_d + fail_r
         stats = {
             "sent": n_sent + n_replies,
-            "failed": n_fail_s + n_fail_d + n_fail_r,
+            # Legacy total, kept bit-for-bit equal to the cause sum (the
+            # causes are mutually exclusive integer tallies).
+            "failed": fails.total(),
+            "failed_drop": fails.drop,
+            "failed_offline": fails.offline,
+            "failed_overflow": fails.overflow,
+            "mailbox_hwm": diag["mailbox_hwm"],
+            "compact_slots": diag["compact_slots"]
+                + reply_diag["compact_slots"],
+            "wide_slots": diag["wide_slots"] + reply_diag["wide_slots"],
             "size": size_s + size_r,
             "local": local,
             "global": glob,
@@ -1210,25 +1295,50 @@ class GossipSimulator(SimulationEventSender):
 
     def _emit_live(self, state: SimState, stats: dict) -> None:
         """Ordered host callback notifying live receivers at a round boundary
-        (the only point a jitted run touches the host; SURVEY §5)."""
+        (the only point a jitted run touches the host; SURVEY §5). Each
+        callback also stamps a host wall-clock sample into
+        ``_live_round_times`` — the basis for the report's per-round timing
+        and rounds/sec EMA when the run is live."""
         names = self._metric_keys()
 
-        def cb(rnd, sent, failed, size, local, glob):
+        def cb(rnd, sent, failed, drop, offline, overflow, size, local, glob):
+            import time as _time
+            times = getattr(self, "_live_round_times", None)
+            if times is not None:
+                times.append(_time.perf_counter())
+            causes = {"drop": int(drop), "offline": int(offline),
+                      "overflow": int(overflow)}
+
             def row(vals):
                 if np.all(np.isnan(vals)):
                     return None
                 return {k: float(v) for k, v in zip(names, vals)}
             self._notify_round(int(rnd), int(sent), int(failed), int(size),
-                               row(local), row(glob), live_only=True)
+                               row(local), row(glob), live_only=True,
+                               causes=causes)
 
         jax.experimental.io_callback(
             cb, None, state.round, stats["sent"], stats["failed"],
-            stats["size"], stats["local"], stats["global"], ordered=True)
+            stats["failed_drop"], stats["failed_offline"],
+            stats["failed_overflow"], stats["size"], stats["local"],
+            stats["global"], ordered=True)
 
     def _cache_salt(self):
         """Extra jit-cache key component for variants whose trace depends on
         mutable static config (e.g. the PENS phase)."""
         return 0
+
+    # Wall time of the most recent cold ``start()`` dispatch (trace +
+    # compile); None until a run has compiled. Read by RunManifest.
+    last_compile_seconds: Optional[float] = None
+
+    def run_manifest(self, extra: Optional[dict] = None):
+        """The once-per-run :class:`~gossipy_tpu.telemetry.RunManifest` for
+        this simulator: config snapshot, backend/mesh/library versions,
+        git rev, :meth:`memory_budget`, and the last cold-compile wall
+        time. Host-side only — safe to call before or after a run."""
+        from ..telemetry import RunManifest
+        return RunManifest.from_simulator(self, extra=extra)
 
     # -- persistence (API parity with reference simul.py:460-494) -----------
 
@@ -1320,9 +1430,17 @@ class GossipSimulator(SimulationEventSender):
             live = False
         first_round = int(np.asarray(state.round))
         cache_k = ("start", n_rounds, self._cache_salt(), live)
-        if cache_k not in self._jit_cache:
+        cold = cache_k not in self._jit_cache
+        if cold:
             self._jit_cache[cache_k] = jax.jit(self._make_run(n_rounds, live))
 
+        import time as _time
+        # Live runs get host wall-clock samples per round boundary (the
+        # ordered io_callback already syncs the host there, so the extra
+        # perf_counter is free); non-live runs have no per-round host
+        # boundary and skip timing rather than invent one.
+        self._live_round_times: Optional[list] = [] if live else None
+        t_run0 = _time.perf_counter()
         if profile_dir is not None:
             with jax.profiler.trace(profile_dir):
                 state, stats = self._jit_cache[cache_k](state, key,
@@ -1330,11 +1448,32 @@ class GossipSimulator(SimulationEventSender):
                 jax.block_until_ready(state.model.params)
         else:
             state, stats = self._jit_cache[cache_k](state, key, self.data)
+        if cold:
+            # Wall time of the cold dispatch: tracing + XLA compilation
+            # (execution is async-dispatched and largely excluded, except
+            # under profile_dir where the block_until_ready above folds the
+            # run in). Recorded for the RunManifest.
+            self.last_compile_seconds = _time.perf_counter() - t_run0
+        # Building the report forces the stats device->host transfer, which
+        # completes only after the program (including its ordered callbacks)
+        # finishes — harvest the live timestamps only after that, or the
+        # async dispatch would race the collection.
+        report = self._build_report(stats)
+        live_times, self._live_round_times = self._live_round_times, None
         self.replay_events(first_round, stats, self._metric_keys(),
                            include_live=live_fallback)
-        return state, self._build_report(stats)
+        if live_times:
+            report.attach_wall_clock(t_run0, live_times)
+        return state, report
 
     def _build_report(self, stats: dict) -> SimulationReport:
+        def opt(k):
+            return np.asarray(stats[k]) if k in stats else None
+        failed_by_cause = None
+        if "failed_drop" in stats:
+            failed_by_cause = {"drop": np.asarray(stats["failed_drop"]),
+                               "offline": np.asarray(stats["failed_offline"]),
+                               "overflow": np.asarray(stats["failed_overflow"])}
         return SimulationReport(
             metric_names=self._metric_keys(),
             local_evals=np.asarray(stats["local"]) if self.has_local_test else None,
@@ -1342,6 +1481,10 @@ class GossipSimulator(SimulationEventSender):
             sent=np.asarray(stats["sent"]),
             failed=np.asarray(stats["failed"]),
             total_size=int(np.asarray(stats["size"]).sum()),
+            failed_by_cause=failed_by_cause,
+            mailbox_hwm=opt("mailbox_hwm"),
+            compact_slots=opt("compact_slots"),
+            wide_slots=opt("wide_slots"),
         )
 
     def run_repetitions(self, n_rounds: int, keys: jax.Array,

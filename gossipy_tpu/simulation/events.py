@@ -49,6 +49,13 @@ class SimulationEventReceiver:
         """Per-round message traffic: ``sent`` messages generated, ``failed``
         lost (drop / churn / overflow), ``size`` total scalars shipped."""
 
+    def update_failure_causes(self, round: int, causes: dict) -> None:
+        """Per-round failure breakdown: ``{"drop": n, "offline": n,
+        "overflow": n}`` (telemetry.FAILURE_CAUSES order; values sum to
+        ``update_message``'s ``failed``). Fired right after
+        ``update_message`` by engines that track causes — both the jitted
+        and the sequential engine do."""
+
     def update_single_message(self, failed: bool, msg) -> None:
         """Per-MESSAGE event (the reference's ``update_message(failed,
         msg)`` granularity, simul.py:55-66). Only the opt-in sequential
@@ -92,13 +99,16 @@ class SimulationEventSender:
     def _notify_round(self, round: int, sent: int, failed: int, size: int,
                       local: Optional[dict], glob: Optional[dict],
                       live_only: bool = False,
-                      include_live: bool = False) -> None:
+                      include_live: bool = False,
+                      causes: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
             if not live_only and r.live and not include_live:
                 continue  # live receivers already saw this round in-run
             r.update_message(round, sent, failed, size)
+            if causes is not None:
+                r.update_failure_causes(round, causes)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -123,6 +133,10 @@ class SimulationEventSender:
         size = np.asarray(stats["size"])
         local = np.asarray(stats["local"])
         glob = np.asarray(stats["global"])
+        cause_arrs = None
+        if "failed_drop" in stats:
+            cause_arrs = {c: np.asarray(stats["failed_" + c])
+                          for c in ("drop", "offline", "overflow")}
 
         def row(arr, i):
             vals = arr[i]
@@ -131,23 +145,42 @@ class SimulationEventSender:
             return {k: float(v) for k, v in zip(metric_names, vals)}
 
         for i in range(sent.shape[0]):
+            causes = ({c: int(a[i]) for c, a in cause_arrs.items()}
+                      if cause_arrs is not None else None)
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
-                               include_live=include_live)
+                               include_live=include_live, causes=causes)
         self._notify_end()
 
 
 class ProgressReceiver(SimulationEventReceiver):
     """Live round-progress printer (replaces the reference's rich progress
-    bars around the time loop, simul.py:384)."""
+    bars around the time loop, simul.py:384).
+
+    Each printed line carries the last evaluated metric, the throughput
+    over the window since the previous print (rounds/s of host wall-clock
+    — meaningful when live; replayed events print the replay rate), and
+    the window's failed-message rate, so a long TPU run stays legible
+    from the terminal: ``[round 120] accuracy=0.9104 | 812.4 r/s |
+    failed 2.1%``.
+    """
 
     live = True
 
     def __init__(self, every: int = 10, metric: str = "accuracy"):
+        import time
         self.every = int(every)
         self.metric = metric
         self._last: dict[str, float] = {}
+        self._clock = time.perf_counter
+        self._t_window: float = self._clock()
+        self._win_sent = 0
+        self._win_failed = 0
+
+    def update_message(self, round, sent, failed, size):
+        self._win_sent += sent
+        self._win_failed += failed
 
     def update_evaluation(self, round, on_user, metrics):
         if not on_user:
@@ -157,7 +190,14 @@ class ProgressReceiver(SimulationEventReceiver):
         if round % self.every == 0:
             val = self._last.get(self.metric)
             extra = f" {self.metric}={val:.4f}" if val is not None else ""
-            print(f"[round {round}]{extra}", flush=True)
+            now = self._clock()
+            rate = self.every / max(now - self._t_window, 1e-9)
+            fail_pct = (self._win_failed / self._win_sent
+                        if self._win_sent else 0.0)
+            print(f"[round {round}]{extra} | {rate:.1f} r/s | "
+                  f"failed {fail_pct:.1%}", flush=True)
+            self._t_window = now
+            self._win_sent = self._win_failed = 0
 
 
 class JSONLinesReceiver(SimulationEventReceiver):
@@ -165,8 +205,23 @@ class JSONLinesReceiver(SimulationEventReceiver):
     reference lists as an open TODO ("Weights and Biases support",
     README.md:50), kept tool-agnostic: any dashboard can tail the .jsonl.
 
-    Each line: ``{"round": r, "sent": n, "failed": n, "size": n,
-    "local": {metric: mean} | null, "global": {...} | null}``.
+    Line schema (``"schema": 2``), one object per round::
+
+        {
+          "schema": 2,            # line-format version (1 had no causes)
+          "round": int,           # 1-based round number
+          "sent": int,            # messages generated this round
+          "failed": int,          # messages lost this round (all causes)
+          "failed_by_cause": {    # breakdown; values sum to "failed";
+            "drop": int,          #   null from engines without causes
+            "offline": int,
+            "overflow": int
+          } | null,
+          "size": int,            # total scalars shipped this round
+          "local":  {metric: mean} | null,   # per-user test sets
+          "global": {metric: mean} | null    # global eval set
+        }
+
     Works replayed (default) or live (``live=True`` streams rows during the
     jitted run through the ordered io_callback).
 
@@ -177,6 +232,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
+    SCHEMA = 2
+
     def __init__(self, path: str, live: bool = False):
         import json
         self._json = json
@@ -186,8 +243,12 @@ class JSONLinesReceiver(SimulationEventReceiver):
         self._fh = open(path, "a", buffering=1)
 
     def update_message(self, round, sent, failed, size):
-        self._row = {"round": round, "sent": sent, "failed": failed,
+        self._row = {"schema": self.SCHEMA, "round": round, "sent": sent,
+                     "failed": failed, "failed_by_cause": None,
                      "size": size, "local": None, "global": None}
+
+    def update_failure_causes(self, round, causes):
+        self._row["failed_by_cause"] = dict(causes)
 
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics

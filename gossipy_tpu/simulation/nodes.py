@@ -452,15 +452,7 @@ class PENSGossipSimulator(GossipSimulator):
             reports.append(rep2)
         if len(reports) == 1:
             return state, reports[0]
-        merged = SimulationReport(
-            metric_names=reports[0].metric_names,
-            local_evals=_cat([r._local for r in reports]),
-            global_evals=_cat([r._global for r in reports]),
-            sent=np.concatenate([r.sent_per_round for r in reports]),
-            failed=np.concatenate([r.failed_per_round for r in reports]),
-            total_size=sum(r.total_size for r in reports),
-        )
-        return state, merged
+        return state, SimulationReport.concatenate(reports)
 
 
     def run_repetitions(self, n_rounds: int, keys, local_train: bool = True,
@@ -511,19 +503,7 @@ class PENSGossipSimulator(GossipSimulator):
         reports = []
         for i, rep1 in enumerate(reports1):
             rep2 = self._build_report(jax.tree.map(lambda a, i=i: a[i], host2))
-            reports.append(SimulationReport(
-                metric_names=rep1.metric_names,
-                local_evals=_cat([rep1._local, rep2._local]),
-                global_evals=_cat([rep1._global, rep2._global]),
-                sent=np.concatenate([rep1.sent_per_round,
-                                     rep2.sent_per_round]),
-                failed=np.concatenate([rep1.failed_per_round,
-                                       rep2.failed_per_round]),
-                total_size=rep1.total_size + rep2.total_size,
-            ))
+            reports.append(SimulationReport.concatenate([rep1, rep2]))
         return states, reports
 
 
-def _cat(arrs):
-    arrs = [a for a in arrs if a is not None]
-    return np.concatenate(arrs) if arrs else None

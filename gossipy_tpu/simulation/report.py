@@ -7,6 +7,20 @@ arrays (message counters, mean metrics) from the scan, and this module wraps
 them in an API-compatible report: ``get_evaluation(local)`` returns the
 ``[(round, {metric: mean})]`` list the reference produces
 (simul.py:262-266).
+
+Telemetry extensions beyond the reference's report:
+
+- ``failed_per_cause``: the per-round failure breakdown
+  (:data:`~gossipy_tpu.telemetry.FAILURE_CAUSES`: drop / offline /
+  overflow) whose per-round sum equals ``failed_per_round`` bit-for-bit.
+- ``mailbox_hwm_per_round`` / ``compact_slots_per_round`` /
+  ``wide_slots_per_round``: mailbox occupancy high-water mark and the
+  compact-vs-wide delivery-path indicator (engine runs only; None from
+  engines without a mailbox).
+- ``wall_clock_seconds_per_round`` / ``rounds_per_sec_ema``: host timing
+  captured through the live io_callback path (None for non-live runs).
+- ``to_dict()`` / ``save(path)``: a JSON-able run record (strict JSON:
+  NaN metric rows become nulls).
 """
 
 from __future__ import annotations
@@ -15,6 +29,8 @@ import json
 from typing import Optional
 
 import numpy as np
+
+REPORT_SCHEMA = 2  # 1: sent/failed/size/evals; 2: + cause breakdown & diag
 
 
 class SimulationReport:
@@ -29,6 +45,10 @@ class SimulationReport:
       (drop, churn, mailbox overflow) per round
     - ``total_size``: cumulative message size in "atomic scalar" units, the
       reference's ``Sizeable`` accounting (gossipy/__init__.py:134-156)
+    - ``failed_by_cause``: optional {cause: [R] int array} breakdown whose
+      per-round sum equals ``failed``
+    - ``mailbox_hwm`` / ``compact_slots`` / ``wide_slots``: optional [R]
+      engine diagnostics (see the engine's ``_deliver_phase``)
     """
 
     def __init__(self,
@@ -37,7 +57,11 @@ class SimulationReport:
                  global_evals: Optional[np.ndarray],
                  sent: np.ndarray,
                  failed: np.ndarray,
-                 total_size: int):
+                 total_size: int,
+                 failed_by_cause: Optional[dict] = None,
+                 mailbox_hwm: Optional[np.ndarray] = None,
+                 compact_slots: Optional[np.ndarray] = None,
+                 wide_slots: Optional[np.ndarray] = None):
         self.metric_names = list(metric_names)
         self._local = local_evals
         self._global = global_evals
@@ -46,6 +70,37 @@ class SimulationReport:
         self.sent_per_round = np.asarray(sent)
         self.failed_per_round = np.asarray(failed)
         self.total_size = int(total_size)
+        self.failed_per_cause: Optional[dict] = (
+            {k: np.asarray(v) for k, v in failed_by_cause.items()}
+            if failed_by_cause is not None else None)
+        self.mailbox_hwm_per_round = (
+            np.asarray(mailbox_hwm) if mailbox_hwm is not None else None)
+        self.compact_slots_per_round = (
+            np.asarray(compact_slots) if compact_slots is not None else None)
+        self.wide_slots_per_round = (
+            np.asarray(wide_slots) if wide_slots is not None else None)
+        # Host wall-clock (live io_callback runs only; attach_wall_clock).
+        self.wall_clock_seconds_per_round: Optional[np.ndarray] = None
+        self.rounds_per_sec_ema: Optional[float] = None
+
+    def attach_wall_clock(self, t_start: float, round_times: list,
+                          ema_alpha: float = 0.1) -> None:
+        """Derive per-round wall-clock and a rounds/sec EMA from the host
+        timestamps the live io_callback collected (one per round boundary,
+        measured from ``t_start`` = just before dispatch). The first
+        interval includes compile time on a cold run — the EMA seeds from
+        the SECOND round when there is one, so a cold compile does not
+        poison the steady-state rate."""
+        ts = np.asarray([t_start] + list(round_times), dtype=np.float64)
+        per_round = np.diff(ts)
+        if per_round.size == 0:
+            return
+        self.wall_clock_seconds_per_round = per_round
+        rates = 1.0 / np.maximum(per_round, 1e-9)
+        ema = rates[1] if rates.size > 1 else rates[0]
+        for v in rates[2:]:
+            ema = (1.0 - ema_alpha) * ema + ema_alpha * v
+        self.rounds_per_sec_ema = float(ema)
 
     def _to_rounds(self, arr: Optional[np.ndarray]):
         if arr is None:
@@ -88,12 +143,89 @@ class SimulationReport:
         return np.nonzero(~np.all(np.isnan(arr), axis=1))[0] + 1
 
     def final(self, metric: str, local: bool = False) -> float:
+        """Last evaluated value of ``metric``; NaN when the metric was never
+        evaluated OR is not one this run's handler produces (an unknown
+        name is an empty series, not an exception — callers probe
+        uniformly across handler types)."""
         arr = self._local if local else self._global
-        if arr is None:
+        if arr is None or metric not in self.metric_names:
             return float("nan")
         col = arr[:, self.metric_names.index(metric)]
         col = col[~np.isnan(col)]
         return float(col[-1]) if len(col) else float("nan")
+
+    def to_dict(self) -> dict:
+        """The full run record as JSON-able primitives (strict JSON: every
+        NaN — skipped-eval metric rows — becomes null)."""
+        def scrub(x):
+            if isinstance(x, list):
+                return [scrub(v) for v in x]
+            if isinstance(x, float) and np.isnan(x):
+                return None
+            return x
+
+        def arr(a):
+            return None if a is None else scrub(np.asarray(a).tolist())
+        return {
+            "schema": REPORT_SCHEMA,
+            "metric_names": self.metric_names,
+            "sent_messages": self.sent_messages,
+            "failed_messages": self.failed_messages,
+            "total_size": self.total_size,
+            "sent_per_round": arr(self.sent_per_round),
+            "failed_per_round": arr(self.failed_per_round),
+            "failed_per_cause": (
+                {k: arr(v) for k, v in self.failed_per_cause.items()}
+                if self.failed_per_cause is not None else None),
+            "mailbox_hwm_per_round": arr(self.mailbox_hwm_per_round),
+            "compact_slots_per_round": arr(self.compact_slots_per_round),
+            "wide_slots_per_round": arr(self.wide_slots_per_round),
+            "local_evals": arr(self._local),
+            "global_evals": arr(self._global),
+            "wall_clock_seconds_per_round":
+                arr(self.wall_clock_seconds_per_round),
+            "rounds_per_sec_ema": self.rounds_per_sec_ema,
+        }
+
+    def save(self, path: str) -> str:
+        """Write :meth:`to_dict` as JSON to ``path``."""
+        with open(path, "w") as fh:
+            json.dump(self.to_dict(), fh, indent=2, allow_nan=False)
+            fh.write("\n")
+        return path
+
+    @classmethod
+    def concatenate(cls, reports: list) -> "SimulationReport":
+        """Stitch consecutive run segments (e.g. the PENS phase split) into
+        one report; optional per-round arrays survive only when EVERY
+        segment carries them."""
+        def cat(arrs):
+            arrs = [a for a in arrs if a is not None]
+            return np.concatenate(arrs) if arrs else None
+
+        def cat_all(key):
+            vals = [getattr(r, key) for r in reports]
+            if any(v is None for v in vals):
+                return None
+            return np.concatenate(vals)
+
+        causes = None
+        if all(r.failed_per_cause is not None for r in reports):
+            keys = reports[0].failed_per_cause.keys()
+            causes = {k: np.concatenate([r.failed_per_cause[k]
+                                         for r in reports]) for k in keys}
+        return cls(
+            metric_names=reports[0].metric_names,
+            local_evals=cat([r._local for r in reports]),
+            global_evals=cat([r._global for r in reports]),
+            sent=np.concatenate([r.sent_per_round for r in reports]),
+            failed=np.concatenate([r.failed_per_round for r in reports]),
+            total_size=sum(r.total_size for r in reports),
+            failed_by_cause=causes,
+            mailbox_hwm=cat_all("mailbox_hwm_per_round"),
+            compact_slots=cat_all("compact_slots_per_round"),
+            wide_slots=cat_all("wide_slots_per_round"),
+        )
 
     def __str__(self) -> str:
         return json.dumps({

@@ -238,6 +238,14 @@ class SequentialGossipSimulator(SimulationEventSender):
                            # msg_queues/rep_queues DefaultDicts)
         sent_pr = np.zeros(n_rounds, np.int64)
         failed_pr = np.zeros(n_rounds, np.int64)
+        # Per-cause breakdown (telemetry.FAILURE_CAUSES), kept column-
+        # compatible with the bulk engine's traced counters. Overflow is
+        # structurally zero here — the eager queues are unbounded, like the
+        # reference's — but the column ships so reports from the two
+        # engines stay directly comparable.
+        drop_pr = np.zeros(n_rounds, np.int64)
+        offline_pr = np.zeros(n_rounds, np.int64)
+        overflow_pr = np.zeros(n_rounds, np.int64)
         size_pr = np.zeros(n_rounds, np.int64)
         local_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
         global_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
@@ -267,6 +275,7 @@ class SequentialGossipSimulator(SimulationEventSender):
                 self._fire_message(False, rec)
             if rng.random() < self.drop_prob:
                 failed_pr[r] += 1
+                drop_pr[r] += 1
                 self._fire_message(True, rec)
                 return
             d = int(np.asarray(self.delay.sample(next_key(), (1,),
@@ -293,6 +302,7 @@ class SequentialGossipSimulator(SimulationEventSender):
             i = p.rec.receiver
             if not is_online[i]:
                 failed_pr[r] += 1
+                offline_pr[r] += 1
                 self._fire_message(True, p.rec)
                 return
             if p.is_reply:
@@ -378,9 +388,13 @@ class SequentialGossipSimulator(SimulationEventSender):
             metric_names=names,
             local_evals=local_rows if self.has_local_test else None,
             global_evals=global_rows if self.has_global_eval else None,
-            sent=sent_pr, failed=failed_pr, total_size=int(size_pr.sum()))
+            sent=sent_pr, failed=failed_pr, total_size=int(size_pr.sum()),
+            failed_by_cause={"drop": drop_pr, "offline": offline_pr,
+                             "overflow": overflow_pr})
         self.replay_events(state.round - n_rounds, {
-            "sent": sent_pr, "failed": failed_pr, "size": size_pr,
+            "sent": sent_pr, "failed": failed_pr,
+            "failed_drop": drop_pr, "failed_offline": offline_pr,
+            "failed_overflow": overflow_pr, "size": size_pr,
             "local": local_rows, "global": global_rows}, names)
         return state, report
 

@@ -16,6 +16,13 @@ import numpy as np
 from ..core import AntiEntropyProtocol, CreateModelMode, MessageType
 from ..flow_control import TokenAccount
 from ..handlers.base import ModelState
+from ..telemetry import (
+    PHASE_EVAL,
+    PHASE_RECEIVE_MERGE,
+    PHASE_SEND,
+    PHASE_TRAIN,
+    FailureCounts,
+)
 from .engine import GossipSimulator, PROTO_TO_MSG, SimState, select_nodes
 from .nodes import PartitioningGossipSimulator
 
@@ -114,7 +121,7 @@ class TokenizedGossipSimulator(GossipSimulator):
         size = self._model_size(state.model.params)
         pending = state.aux["pending_reactions"]
         n_sent = jnp.int32(0)
-        n_failed = jnp.int32(0)
+        fails = FailureCounts.zeros()
         total_size = jnp.int32(0)
         msg_type = PROTO_TO_MSG[self.protocol]
         for j in range(self.max_reactions):
@@ -133,18 +140,18 @@ class TokenizedGossipSimulator(GossipSimulator):
             dr = jnp.maximum(delays // self.delta, 1)
             n_sent += active.sum()
             total_size += active.sum() * size
-            n_failed += (active & dropped).sum()
+            fails = fails._replace(drop=fails.drop + (active & dropped).sum())
             live = active & ~dropped
             box, n_overflow = self._scatter_messages(
                 state.mailbox, live, dr, peers, jnp.arange(n, dtype=jnp.int32),
                 jnp.broadcast_to(r.astype(jnp.int32), (n,)),
                 jnp.full((n,), int(msg_type), dtype=jnp.int32),
                 self._send_extra(self._round_key(base_key, r, _K_REACT_EXTRA + 10 * j), state), r, self.K)
-            n_failed += n_overflow
+            fails = fails._replace(overflow=fails.overflow + n_overflow)
             state = state._replace(mailbox=box)
         aux = dict(state.aux)
         aux["pending_reactions"] = jnp.zeros_like(pending)
-        return state._replace(aux=aux), n_sent, n_failed, total_size
+        return state._replace(aux=aux), n_sent, fails, total_size
 
 
 class TokenizedPartitioningGossipSimulator(TokenizedGossipSimulator,
@@ -289,12 +296,14 @@ class All2AllGossipSimulator(GossipSimulator):
 
     def _round(self, state: SimState, base_key: jax.Array, last_round=None):
         r = state.round
-        state = self._snapshot(state, r)
-        n = self.n_nodes
-        fires, _ = self._fire_mask(state, r)
+        with jax.named_scope(PHASE_SEND):
+            state = self._snapshot(state, r)
+            n = self.n_nodes
+            fires, _ = self._fire_mask(state, r)
 
-        online = jax.random.bernoulli(
-            self._round_key(base_key, r, _K_A2A_ONLINE), self.online_prob, (n,))
+            online = jax.random.bernoulli(
+                self._round_key(base_key, r, _K_A2A_ONLINE),
+                self.online_prob, (n,))
         if self.sparse_mix and self._sparse_padded:
             # Padded [N, max_deg] formulation (near-regular graphs): the
             # merge is a gather + einsum — regular shapes, no scatter; the
@@ -321,7 +330,11 @@ class All2AllGossipSimulator(GossipSimulator):
                 return jax.tree.map(leaf, params)
 
             n_sent = sent.sum()
-            n_failed = (sent & (drop | ~online[:, None])).sum()
+            # Cause attribution matches the bulk engine: a dropped message
+            # never reaches its receiver, so drop is charged first and
+            # offline only on surviving edges.
+            n_drop = (sent & drop).sum()
+            n_offline = (sent & ~drop & ~online[:, None]).sum()
             received_any = (live & (wt > 0)).any(axis=1)
 
             def age_max(n_updates):
@@ -359,7 +372,8 @@ class All2AllGossipSimulator(GossipSimulator):
                 return jax.tree.map(leaf, params)
 
             n_sent = sent_e.sum()
-            n_failed = (sent_e & (drop_e | ~online[mix.rows])).sum()
+            n_drop = (sent_e & drop_e).sum()
+            n_offline = (sent_e & ~drop_e & ~online[mix.rows]).sum()
             received_any = jax.ops.segment_max(
                 (live_e & (mix.edge_w > 0)).astype(jnp.int32), mix.rows, n,
                 indices_are_sorted=True) > 0
@@ -382,8 +396,10 @@ class All2AllGossipSimulator(GossipSimulator):
             row_sum = w.sum(axis=1, keepdims=True)
             w_eff = w / jnp.maximum(row_sum, 1e-12)
 
-            n_sent = (adj & fires[None, :]).sum()
-            n_failed = (adj & fires[None, :] & (drop | ~online[:, None])).sum()
+            sent_mask = adj & fires[None, :]
+            n_sent = sent_mask.sum()
+            n_drop = (sent_mask & drop).sum()
+            n_offline = (sent_mask & ~drop & ~online[:, None]).sum()
             received_any = (live & (self.mixing > 0)).any(axis=1)
 
             def age_max(n_updates):
@@ -407,34 +423,57 @@ class All2AllGossipSimulator(GossipSimulator):
         size = self._model_size(state.model.params)
         mode = self.handler.mode
         if mode == CreateModelMode.UPDATE_MERGE:
-            keys = jax.random.split(self._round_key(base_key, r, _K_A2A_UPDATE), n)
-            updated = jax.vmap(self.handler.update)(
-                state.model, self._local_data(), keys)
-            # Only nodes that fired (timed out) train this round
-            # (node.py:833-843) — same gate as the MERGE_UPDATE branch.
-            model = select_nodes(fires, updated, state.model)
-            mixed = mix_tree(model.params)
+            with jax.named_scope(PHASE_TRAIN):
+                keys = jax.random.split(
+                    self._round_key(base_key, r, _K_A2A_UPDATE), n)
+                updated = jax.vmap(self.handler.update)(
+                    state.model, self._local_data(), keys)
+                # Only nodes that fired (timed out) train this round
+                # (node.py:833-843) — same gate as the MERGE_UPDATE branch.
+                model = select_nodes(fires, updated, state.model)
+            with jax.named_scope(PHASE_RECEIVE_MERGE):
+                mixed = mix_tree(model.params)
         else:  # MERGE_UPDATE (the reference's supported path, handler.py:652-654)
-            mixed = mix_tree(state.model.params)
+            with jax.named_scope(PHASE_RECEIVE_MERGE):
+                mixed = mix_tree(state.model.params)
             model = state.model
-        ages = age_max(model.n_updates)
-        new_age = jnp.maximum(model.n_updates, ages)
-        params = select_nodes(received_any, mixed, model.params)
-        model = ModelState(params, model.opt_state,
-                           jnp.where(received_any, new_age, model.n_updates))
+        with jax.named_scope(PHASE_RECEIVE_MERGE):
+            ages = age_max(model.n_updates)
+            new_age = jnp.maximum(model.n_updates, ages)
+            params = select_nodes(received_any, mixed, model.params)
+            model = ModelState(params, model.opt_state,
+                               jnp.where(received_any, new_age,
+                                         model.n_updates))
 
         if mode != CreateModelMode.UPDATE_MERGE:
-            keys = jax.random.split(self._round_key(base_key, r, _K_A2A_UPDATE), n)
-            updated = jax.vmap(self.handler.update)(model, self._local_data(), keys)
-            # Only nodes that fired (timed out) train this round (node.py:833-843).
-            model = select_nodes(fires, updated, model)
+            with jax.named_scope(PHASE_TRAIN):
+                keys = jax.random.split(
+                    self._round_key(base_key, r, _K_A2A_UPDATE), n)
+                updated = jax.vmap(self.handler.update)(
+                    model, self._local_data(), keys)
+                # Only nodes that fired (timed out) train this round
+                # (node.py:833-843).
+                model = select_nodes(fires, updated, model)
 
         state = state._replace(model=model)
-        local, glob = self._maybe_eval(state, base_key, r, last_round)
+        with jax.named_scope(PHASE_EVAL):
+            local, glob = self._maybe_eval(state, base_key, r, last_round)
         state = state._replace(round=r + 1)
+        fails = FailureCounts(drop=n_drop.astype(jnp.int32),
+                              offline=n_offline.astype(jnp.int32),
+                              overflow=jnp.int32(0))
         stats = {
             "sent": n_sent,
-            "failed": n_failed,
+            "failed": fails.total(),
+            "failed_drop": fails.drop,
+            "failed_offline": fails.offline,
+            "failed_overflow": fails.overflow,
+            # Broadcast mixing has no mailbox and one fused delivery path:
+            # the per-round diagnostics are structurally zero, kept so the
+            # report/JSONL columns line up across simulators.
+            "mailbox_hwm": jnp.int32(0),
+            "compact_slots": jnp.int32(0),
+            "wide_slots": jnp.int32(0),
             "size": n_sent * size,
             "local": local,
             "global": glob,

@@ -1,0 +1,42 @@
+"""Telemetry: traced diagnostics, phase scopes, run manifests, event sink.
+
+Four host/trace-side pillars the simulation engines thread through
+(none of this module imports the engines — the dependency points the
+other way, so everything here is importable standalone):
+
+- :mod:`.causes` — :class:`FailureCounts`, the per-cause failed-message
+  accumulator carried through the jitted round scan (drop / offline /
+  overflow instead of one opaque ``failed`` sum).
+- :mod:`.scopes` — ``jax.named_scope`` phase names (:data:`ROUND_PHASES`)
+  wrapped around the round program so XProf traces and compiled HLO show
+  send / receive-merge / train / eval attribution.
+- :mod:`.manifest` — :class:`RunManifest`, the once-per-run JSON record of
+  config + versions + hardware + memory budget + compile wall-time.
+- :mod:`.sink` — process-wide structured event sink
+  (:func:`emit_event` / :func:`get_sink`) that the engine's diagnostics
+  (mailbox undersized, eval-memory) report to alongside their warnings.
+"""
+
+from .causes import FAILURE_CAUSES, FailureCounts
+from .manifest import MANIFEST_SCHEMA, RunManifest, git_revision
+from .scopes import (
+    PHASE_EVAL,
+    PHASE_RECEIVE_MERGE,
+    PHASE_REPLY,
+    PHASE_SEND,
+    PHASE_TRAIN,
+    ROUND_PHASES,
+    phase_scope,
+    phases_in_text,
+    phases_in_trace_dir,
+)
+from .sink import TelemetryEvent, TelemetrySink, emit_event, get_sink, set_sink
+
+__all__ = [
+    "FAILURE_CAUSES", "FailureCounts",
+    "RunManifest", "MANIFEST_SCHEMA", "git_revision",
+    "PHASE_SEND", "PHASE_RECEIVE_MERGE", "PHASE_TRAIN", "PHASE_EVAL",
+    "PHASE_REPLY", "ROUND_PHASES", "phase_scope", "phases_in_text",
+    "phases_in_trace_dir",
+    "TelemetryEvent", "TelemetrySink", "emit_event", "get_sink", "set_sink",
+]

@@ -1,0 +1,67 @@
+"""Traced failure-cause accounting for the simulation engines.
+
+The engines have always computed the three ways a message can die — the
+send-time drop draw, an offline receiver at delivery, and mailbox slot
+overflow — as separate masks (engine.py ``_send_phase`` /
+``_deliver_phase`` / ``_scatter_messages``), then summed them into one
+``failed`` counter. :class:`FailureCounts` keeps the three per-cause
+tallies apart all the way through the scan's accumulators, at the cost of
+two extra int32 scalars per round.
+
+Invariant relied on by the report layer and asserted in tests: the causes
+are mutually exclusive per message (a dropped message is never scattered,
+an overflowed one is never read back, and the offline check only sees
+messages that made it into a slot), so
+``drop + offline + overflow == failed`` holds bit-for-bit per round.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+# Canonical cause ordering — report dicts, JSONL rows and event payloads
+# all key on these names.
+FAILURE_CAUSES = ("drop", "offline", "overflow")
+
+
+class FailureCounts(NamedTuple):
+    """Per-cause failed-message counters (int32 scalars under trace).
+
+    - ``drop``: lost to the send-time Bernoulli drop draw (reference
+      simul.py:403-407) — includes dropped replies and reaction sends.
+    - ``offline``: reached a mailbox slot but the receiver's availability
+      draw failed at delivery (simul.py:419-428).
+    - ``overflow``: no free slot in the receiver's per-round mailbox cell
+      (an engine-only cause: the reference's Python queues are unbounded,
+      and so are the sequential engine's).
+    """
+
+    drop: Union[jax.Array, int]
+    offline: Union[jax.Array, int]
+    overflow: Union[jax.Array, int]
+
+    @classmethod
+    def zeros(cls) -> "FailureCounts":
+        return cls(jnp.int32(0), jnp.int32(0), jnp.int32(0))
+
+    # NamedTuple's inherited ``+`` is tuple concatenation — override with
+    # the elementwise sum so accumulator code reads naturally.
+    def __add__(self, other: "FailureCounts") -> "FailureCounts":  # type: ignore[override]
+        return FailureCounts(self.drop + other.drop,
+                             self.offline + other.offline,
+                             self.overflow + other.overflow)
+
+    def __radd__(self, other):
+        if other == 0:  # support sum([...])
+            return self
+        return self.__add__(other)
+
+    def total(self):
+        """The legacy ``failed`` counter: the exact sum of the causes."""
+        return self.drop + self.offline + self.overflow
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in FAILURE_CAUSES}

@@ -1,0 +1,208 @@
+"""Run manifest: the one JSON record that says what produced a curve.
+
+The pjit-at-scale literature leans on exactly this artifact — a structured
+snapshot of configuration + software + hardware emitted once per run — to
+make throughput numbers and learning curves attributable after the fact.
+:class:`RunManifest` collects, host-side and without touching the device:
+
+- the simulator's configuration snapshot (population, protocol, fault
+  rates, mailbox geometry, handler/topology classes, delivery path),
+- software versions (jax/jaxlib/flax/optax/numpy) and the git revision,
+- the backend, device kind/count and mesh shape (when one is attached),
+- the engine's :meth:`~gossipy_tpu.simulation.engine.GossipSimulator.
+  memory_budget` output and the measured compile wall-time of the last
+  cold ``start()`` call.
+
+``bench.py`` emits one per measured run (stderr + optional file; the
+stdout one-line metric contract is untouched).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+MANIFEST_SCHEMA = 1
+
+
+def _versions() -> dict:
+    out = {}
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        try:
+            out[mod] = __import__(mod).__version__
+        except Exception:
+            out[mod] = None
+    return out
+
+
+def git_revision(cwd: Optional[str] = None) -> Optional[str]:
+    """Short git HEAD of the checkout containing THIS package (or of
+    ``cwd`` when given), or None outside a repo / without git. Anchoring
+    to the package path keeps the recorded rev meaningful no matter what
+    directory the run was launched from."""
+    if cwd is None:
+        import os
+        cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, text=True, timeout=10,
+                              cwd=cwd)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    rev = proc.stdout.strip()
+    return rev if proc.returncode == 0 and rev else None
+
+
+def _backend_info() -> dict:
+    import jax
+    try:
+        devs = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "device_kind": devs[0].device_kind if devs else None,
+            "device_count": len(devs),
+            "process_count": jax.process_count(),
+        }
+    except Exception as e:  # backend init failure must not kill the record
+        return {"backend": None, "error": repr(e)[:200]}
+
+
+def _config_snapshot(sim: Any) -> dict:
+    """Best-effort config dict from a simulator's public attributes.
+
+    Reads via ``getattr`` so every engine subclass (variants, the
+    sequential engine) produces a snapshot without implementing anything;
+    absent knobs are simply omitted.
+    """
+    snap: dict = {"simulator": type(sim).__name__}
+    handler = getattr(sim, "handler", None)
+    if handler is not None:
+        snap["handler"] = type(handler).__name__
+        mode = getattr(handler, "mode", None)
+        if mode is not None:
+            snap["create_model_mode"] = getattr(mode, "name", str(mode))
+    topo = getattr(sim, "topology", None)
+    if topo is not None:
+        snap["topology"] = type(topo).__name__
+    for attr, key in (("n_nodes", "n_nodes"), ("delta", "delta"),
+                      ("drop_prob", "drop_prob"),
+                      ("online_prob", "online_prob"),
+                      ("sampling_eval", "sampling_eval"),
+                      ("eval_every", "eval_every"), ("sync", "sync"),
+                      ("K", "mailbox_slots"), ("Kr", "reply_slots"),
+                      ("F", "max_fires_per_round"),
+                      ("fused_merge", "fused_merge"),
+                      ("_compact_cap", "compact_cap")):
+        if hasattr(sim, attr):
+            snap[key] = getattr(sim, attr)
+    proto = getattr(sim, "protocol", None)
+    if proto is not None:
+        snap["protocol"] = getattr(proto, "name", str(proto))
+    delay = getattr(sim, "delay", None)
+    if delay is not None:
+        snap["delay"] = repr(delay)
+    return snap
+
+
+def _mesh_info(sim: Any) -> Optional[dict]:
+    mesh = getattr(sim, "mesh", None)
+    if mesh is None:
+        return None
+    try:
+        return {"axis_names": list(mesh.axis_names),
+                "shape": {str(k): int(v)
+                          for k, v in dict(mesh.shape).items()}}
+    except Exception:
+        return {"repr": repr(mesh)[:200]}
+
+
+@dataclass
+class RunManifest:
+    """Immutable run record; build with :meth:`from_simulator`."""
+
+    config: dict
+    backend: dict
+    versions: dict
+    git_rev: Optional[str] = None
+    memory_budget: Optional[dict] = None
+    mesh: Optional[dict] = None
+    compile_seconds: Optional[float] = None
+    created_at: float = field(default_factory=time.time)
+    extra: dict = field(default_factory=dict)
+    schema: int = MANIFEST_SCHEMA
+
+    @classmethod
+    def from_simulator(cls, sim: Any,
+                       compile_seconds: Optional[float] = None,
+                       extra: Optional[dict] = None) -> "RunManifest":
+        """Collect the manifest for ``sim``.
+
+        ``compile_seconds`` defaults to the simulator's recorded
+        ``last_compile_seconds`` (the wall time of the most recent cold
+        ``start()`` dispatch — tracing + XLA compilation; execution is
+        dispatched asynchronously and not included).
+        """
+        budget = None
+        if hasattr(sim, "memory_budget"):
+            try:
+                budget = sim.memory_budget()
+            except Exception:  # shape-only eval may resist exotic variants
+                budget = None
+        if compile_seconds is None:
+            compile_seconds = getattr(sim, "last_compile_seconds", None)
+        return cls(
+            config=_config_snapshot(sim),
+            backend=_backend_info(),
+            versions=_versions(),
+            git_rev=git_revision(),
+            memory_budget=budget,
+            mesh=_mesh_info(sim),
+            compile_seconds=compile_seconds,
+            extra=dict(extra or {}),
+        )
+
+    def to_dict(self) -> dict:
+        out = {
+            "schema": self.schema,
+            "created_at": self.created_at,
+            "config": self.config,
+            "backend": self.backend,
+            "versions": self.versions,
+            "git_rev": self.git_rev,
+            "memory_budget": self.memory_budget,
+            "mesh": self.mesh,
+            "compile_seconds": self.compile_seconds,
+        }
+        if self.extra:
+            out["extra"] = self.extra
+        return _jsonable(out)
+
+    def to_json(self, **kwargs) -> str:
+        return json.dumps(self.to_dict(), **kwargs)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as fh:
+            fh.write(self.to_json(indent=2) + "\n")
+        return path
+
+
+def _jsonable(obj):
+    """Coerce numpy/jax scalars so ``json.dumps`` never chokes on a
+    config value; unknown objects fall back to ``repr``."""
+    import numpy as np
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)[:200]

@@ -1,0 +1,69 @@
+"""Named profiler scopes for the round program's phases.
+
+``jax.named_scope`` pushes a name onto JAX's tracing name stack; the name
+survives into the compiled HLO's per-op metadata (``op_name``) and into
+XProf/TensorBoard traces captured via ``GossipSimulator.start(...,
+profile_dir=...)``. With the engine's phases wrapped, a trace shows
+``gossipy.send`` / ``gossipy.receive_merge`` / ``gossipy.train`` /
+``gossipy.eval`` bands instead of one opaque scan body — direct phase
+attribution where ``scripts/profile_round.py`` previously had to
+difference whole-run configurations.
+
+The scope names are plain attributes here (not an enum) so host-side
+tools — the profiler script's HLO/trace cross-check, tests — can iterate
+:data:`ROUND_PHASES` without importing any engine code.
+"""
+
+from __future__ import annotations
+
+import jax
+
+PHASE_SEND = "gossipy.send"                    # fire mask, peer sampling, scatter
+PHASE_RECEIVE_MERGE = "gossipy.receive_merge"  # mailbox read, gather, merge dispatch
+PHASE_TRAIN = "gossipy.train"                  # the vmapped handler call/update pass
+PHASE_EVAL = "gossipy.eval"                    # local/global evaluation
+PHASE_REPLY = "gossipy.reply"                  # PULL/PUSH_PULL reply drain (elided for PUSH)
+
+# The four phases every protocol's round program contains (PHASE_REPLY is
+# structurally absent from PUSH-only programs, so it is not in this list).
+ROUND_PHASES = (PHASE_SEND, PHASE_RECEIVE_MERGE, PHASE_TRAIN, PHASE_EVAL)
+
+
+def phase_scope(name: str):
+    """A ``jax.named_scope`` for one round phase (context manager)."""
+    return jax.named_scope(name)
+
+
+def phases_in_text(text: str, phases=ROUND_PHASES) -> list:
+    """Which phase names appear in ``text`` (compiled-HLO dump or any
+    decoded trace content). Order follows ``phases``."""
+    return [p for p in phases if p in text]
+
+
+def phases_in_trace_dir(trace_dir: str, phases=ROUND_PHASES) -> list:
+    """Which phase names appear anywhere in a ``jax.profiler`` trace
+    directory. XProf writes protobuf ``.xplane.pb`` (and optionally
+    ``.json.gz``) files whose event names embed the HLO op metadata as
+    plain bytes, so a substring scan over the raw files is a reliable
+    presence check without a protobuf dependency."""
+    import gzip
+    import os
+
+    needles = {p: p.encode() for p in phases}
+    found = set()
+    for root, _, files in os.walk(trace_dir):
+        for fname in files:
+            path = os.path.join(root, fname)
+            try:
+                with open(path, "rb") as fh:
+                    blob = fh.read()
+                if fname.endswith(".gz"):
+                    blob = gzip.decompress(blob)
+            except OSError:
+                continue
+            for p, needle in needles.items():
+                if p not in found and needle in blob:
+                    found.add(p)
+        if len(found) == len(phases):
+            break
+    return [p for p in phases if p in found]

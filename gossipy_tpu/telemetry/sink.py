@@ -1,0 +1,92 @@
+"""Structured telemetry events: a process-wide sink for engine diagnostics.
+
+The engine's construction-time diagnostics (undersized mailbox, huge eval
+tensor) have so far been ``warnings.warn`` strings — visible on a terminal,
+invisible to any tool. Each such diagnostic now ALSO lands here as a
+:class:`TelemetryEvent` (machine-readable kind + payload dict), kept in an
+in-memory ring and optionally mirrored to a JSONL file, so a run harness
+can assert on them, a dashboard can tail them, and a post-mortem can read
+what the engine knew before the run started. The human warning is
+unchanged — the sink is an addition, not a replacement.
+
+Usage::
+
+    from gossipy_tpu.telemetry import get_sink, set_sink, TelemetrySink
+    set_sink(TelemetrySink(jsonl_path="events.jsonl"))  # optional mirror
+    ...build/run simulators...
+    for ev in get_sink().events(kind="mailbox_undersized"):
+        print(ev.kind, ev.data)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class TelemetryEvent:
+    """One structured diagnostic: a ``kind`` tag plus a JSON-able payload."""
+
+    kind: str
+    data: dict
+    ts: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "ts": self.ts, "data": self.data}
+
+
+class TelemetrySink:
+    """Bounded in-memory event ring with an optional JSONL mirror.
+
+    ``maxlen`` bounds host memory (old events fall off the front);
+    ``jsonl_path`` appends every event as one JSON line the moment it is
+    emitted (line-buffered, so a crashed run keeps its events).
+    """
+
+    def __init__(self, maxlen: int = 1024,
+                 jsonl_path: Optional[str] = None):
+        self._events: deque = deque(maxlen=maxlen)
+        self._fh = open(jsonl_path, "a", buffering=1) if jsonl_path else None
+
+    def emit(self, kind: str, data: dict) -> TelemetryEvent:
+        ev = TelemetryEvent(kind=kind, data=dict(data))
+        self._events.append(ev)
+        if self._fh is not None:
+            self._fh.write(json.dumps(ev.to_dict()) + "\n")
+        return ev
+
+    def events(self, kind: Optional[str] = None) -> list:
+        evs = list(self._events)
+        return evs if kind is None else [e for e in evs if e.kind == kind]
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+_SINK: TelemetrySink = TelemetrySink()
+
+
+def get_sink() -> TelemetrySink:
+    return _SINK
+
+
+def set_sink(sink: TelemetrySink) -> TelemetrySink:
+    """Install ``sink`` as the process-wide sink; returns the previous one
+    (so tests can restore it)."""
+    global _SINK
+    prev, _SINK = _SINK, sink
+    return prev
+
+
+def emit_event(kind: str, data: dict) -> TelemetryEvent:
+    """Emit one structured event to the current process-wide sink."""
+    return _SINK.emit(kind, data)

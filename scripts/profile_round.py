@@ -11,6 +11,14 @@ to reach for when attacking the MFU number on real hardware (VERDICT
 round-2 #2): it says whether the round is train-bound, eval-bound, or
 exchange-bound before any kernel work starts.
 
+The round program's phases are additionally wrapped in ``jax.named_scope``
+(:mod:`gossipy_tpu.telemetry.scopes`), so the differential numbers can be
+cross-checked against direct attribution: the JSON row reports which phase
+scopes the compiled HLO carries, and with ``--trace`` the dumped XProf
+trace is scanned for the same names — open it in
+TensorBoard/XProf and the named phase bands give per-op timing the
+differencing can only approximate.
+
 Usage (repo root):
     python scripts/profile_round.py              # north-star LogReg config
     python scripts/profile_round.py --cnn        # flagship CIFAR CNN config
@@ -130,10 +138,19 @@ def main() -> None:
     sim = build_sim(args.cnn, n_nodes, sampling_eval=sampling)
     key = jax.random.PRNGKey(42)
     state = sim.init_nodes(key)
-    cost = sim.lower_start(state, n_rounds=1, key=key).compile() \
-        .cost_analysis()
+    compiled = sim.lower_start(state, n_rounds=1, key=key).compile()
+    cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
+    # Phase-scope cross-check: the named scopes the round program carries
+    # (telemetry.scopes). All four in ROUND_PHASES should appear — a
+    # missing one means the differential attribution below is the only
+    # signal left for that phase.
+    from gossipy_tpu.telemetry import ROUND_PHASES, phases_in_text
+    try:
+        scopes_in_hlo = phases_in_text(compiled.as_text())
+    except Exception:  # some backends cannot re-serialize the executable
+        scopes_in_hlo = None
 
     full = time_config(rounds, cnn=args.cnn, n_nodes=n_nodes,
                        sampling_eval=sampling)
@@ -162,6 +179,8 @@ def main() -> None:
         "note": "differential attribution assumes steady state; at small "
                 "--rounds the legs carry run-to-run noise and can go "
                 "slightly negative",
+        "phase_scopes_in_hlo": scopes_in_hlo,
+        "phase_scopes_expected": list(ROUND_PHASES),
         "xla_per_round": {
             "gflops": round(flops / 1e9, 3) if np.isfinite(flops) else None,
             "gbytes_accessed": (round(bytes_ac / 1e9, 3)
@@ -180,6 +199,15 @@ def main() -> None:
             s3, _ = sim.start(state, n_rounds=rounds, key=key)
             jax.block_until_ready(s3.model.params)
         print(f"[profile] trace written to {args.trace}", file=sys.stderr)
+        # Cross-check the differential attribution against the scoped
+        # trace: the XProf dump should name the same phases the HLO does
+        # (open it in TensorBoard for per-op timings under each band).
+        from gossipy_tpu.telemetry import phases_in_trace_dir
+        in_trace = phases_in_trace_dir(args.trace)
+        missing = [p for p in ROUND_PHASES if p not in in_trace]
+        print(f"[profile] phase scopes in trace: {in_trace}"
+              + (f" (missing: {missing})" if missing else " (all present)"),
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

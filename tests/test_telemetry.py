@@ -1,0 +1,407 @@
+"""Telemetry subsystem tests: traced failure causes, phase scopes, run
+manifest, report serialization, event sink, and the live-delivery path."""
+
+import json
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import PegasosHandler
+from gossipy_tpu.models import AdaLine
+from gossipy_tpu.simulation import (
+    GossipSimulator,
+    JSONLinesReceiver,
+    ProgressReceiver,
+    SequentialGossipSimulator,
+    SimulationEventReceiver,
+    SimulationReport,
+)
+from gossipy_tpu.telemetry import (
+    FAILURE_CAUSES,
+    ROUND_PHASES,
+    FailureCounts,
+    RunManifest,
+    TelemetrySink,
+    get_sink,
+    phases_in_text,
+    set_sink,
+)
+
+N_FAULTY = 100
+
+
+def make_dataset(n_nodes, seed=0, n_samples=None):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=6)
+    X = rng.normal(size=(n_samples or 20 * n_nodes, 6)).astype(np.float32)
+    y = (2 * (X @ w > 0) - 1).astype(np.float32)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    return DataDispatcher(dh, n=n_nodes)
+
+
+def make_handler():
+    return PegasosHandler(AdaLine(6), learning_rate=0.01,
+                          create_model_mode=CreateModelMode.UPDATE)
+
+
+def faulty_sim(n_nodes=N_FAULTY, **kwargs):
+    """The acceptance config: all three failure causes active (drop draw,
+    offline receivers, and a 1-slot mailbox that must overflow at clique
+    fan-in)."""
+    kwargs.setdefault("drop_prob", 0.3)
+    kwargs.setdefault("online_prob", 0.7)
+    kwargs.setdefault("mailbox_slots", 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # undersized mailbox is the point
+        return GossipSimulator(make_handler(), Topology.clique(n_nodes),
+                               make_dataset(n_nodes).stacked(), delta=10,
+                               protocol=AntiEntropyProtocol.PUSH, **kwargs)
+
+
+class TestFailureCounts:
+    def test_elementwise_add_and_total(self):
+        a = FailureCounts(1, 2, 3)
+        b = FailureCounts(10, 20, 30)
+        c = a + b
+        assert (c.drop, c.offline, c.overflow) == (11, 22, 33)
+        assert c.total() == 66
+        assert sum([a, b]).total() == 66  # __radd__ supports sum()
+
+    def test_cause_names(self):
+        assert set(FailureCounts.zeros().as_dict()) == set(FAILURE_CAUSES)
+        assert FAILURE_CAUSES == ("drop", "offline", "overflow")
+
+
+class TestPerCauseCounters:
+    def test_engine_causes_sum_to_failed_bitwise(self, key):
+        """Acceptance: on a faulty 100-node config every cause array is
+        nonzero where expected and the per-round cause sum equals the
+        legacy ``failed`` array bit-for-bit."""
+        sim = faulty_sim()
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=5, key=key)
+        assert rep.failed_per_cause is not None
+        assert set(rep.failed_per_cause) == set(FAILURE_CAUSES)
+        total = sum(rep.failed_per_cause.values())
+        np.testing.assert_array_equal(total, rep.failed_per_round)
+        # All three causes fire under this config: drop_prob=0.3,
+        # online_prob=0.7, and a 1-slot mailbox at clique fan-in.
+        for cause in FAILURE_CAUSES:
+            assert rep.failed_per_cause[cause].sum() > 0, cause
+
+    def test_sequential_causes_sum_to_failed_bitwise(self, key):
+        """The high-fidelity engine emits the same breakdown (overflow is
+        structurally zero: its queues are unbounded, like the
+        reference's)."""
+        n = N_FAULTY
+        sim = SequentialGossipSimulator(
+            make_handler(), Topology.clique(n),
+            make_dataset(n, n_samples=4 * n).stacked(), delta=4,
+            drop_prob=0.3, online_prob=0.7)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=2, key=key)
+        assert rep.failed_per_cause is not None
+        total = sum(rep.failed_per_cause.values())
+        np.testing.assert_array_equal(total, rep.failed_per_round)
+        assert rep.failed_per_cause["drop"].sum() > 0
+        assert rep.failed_per_cause["offline"].sum() > 0
+        assert rep.failed_per_cause["overflow"].sum() == 0
+
+    def test_no_fault_config_has_zero_causes(self, key):
+        sim = faulty_sim(n_nodes=16, drop_prob=0.0, online_prob=1.0,
+                         mailbox_slots=None)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=3, key=key)
+        assert rep.failed_messages == 0
+        for cause in FAILURE_CAUSES:
+            assert rep.failed_per_cause[cause].sum() == 0
+
+    def test_all2all_causes_sum_to_failed(self, key):
+        import optax
+
+        from gossipy_tpu.core import uniform_mixing
+        from gossipy_tpu.handlers import WeightedSGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import All2AllGossipSimulator
+
+        n = 12
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=6)
+        X = rng.normal(size=(20 * n, 6)).astype(np.float32)
+        y = (X @ w > 0).astype(np.int64)
+        disp = DataDispatcher(
+            ClassificationDataHandler(X, y, test_size=0.25, seed=1), n=n,
+            eval_on_user=False)
+        handler = WeightedSGDHandler(
+            model=LogisticRegression(6, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(6,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        topo = Topology.random_regular(n, 4, seed=1)
+        sim = All2AllGossipSimulator(handler, topo, disp.stacked(), delta=5,
+                                     mixing=uniform_mixing(topo),
+                                     drop_prob=0.2, online_prob=0.8)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=4, key=key)
+        total = sum(rep.failed_per_cause.values())
+        np.testing.assert_array_equal(total, rep.failed_per_round)
+        assert rep.failed_per_cause["drop"].sum() > 0
+        assert rep.failed_per_cause["offline"].sum() > 0
+        assert rep.failed_per_cause["overflow"].sum() == 0
+
+
+class TestRoundDiagnostics:
+    def test_mailbox_hwm_bounded_by_slots(self, key):
+        sim = faulty_sim(n_nodes=24, mailbox_slots=3)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=4, key=key)
+        hwm = rep.mailbox_hwm_per_round
+        assert hwm is not None and hwm.shape == (4,)
+        assert (hwm >= 1).all()   # clique fan-in: someone always receives
+        assert (hwm <= 3).all()   # bounded by the slot capacity
+
+    def test_compact_wide_indicator(self, key):
+        # Explicit small capacity: slot 0 (clique fan-in ~everyone)
+        # overflows it and runs wide; higher slots run compact.
+        sim = faulty_sim(n_nodes=32, drop_prob=0.0, online_prob=1.0,
+                         mailbox_slots=4, compact_deliver=4)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=4, key=key)
+        assert rep.compact_slots_per_round is not None
+        assert (rep.wide_slots_per_round >= 1).all()  # slot 0 goes wide
+        occupied = rep.compact_slots_per_round + rep.wide_slots_per_round
+        assert (occupied >= 1).all() and (occupied <= 4 + 2).all()
+
+    def test_wide_only_when_compaction_off(self, key):
+        sim = faulty_sim(n_nodes=16, drop_prob=0.0, online_prob=1.0,
+                         mailbox_slots=2, compact_deliver=False)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=3, key=key)
+        assert (rep.compact_slots_per_round == 0).all()
+        assert rep.wide_slots_per_round.sum() >= 1
+
+
+class TestReport:
+    def _report(self, **kw):
+        defaults = dict(
+            metric_names=["accuracy"],
+            local_evals=None,
+            global_evals=np.array([[0.5], [np.nan], [0.7]], np.float32),
+            sent=np.array([3, 4, 5]), failed=np.array([1, 0, 2]),
+            total_size=12)
+        defaults.update(kw)
+        return SimulationReport(**defaults)
+
+    def test_final_unknown_metric_returns_nan(self):
+        rep = self._report()
+        assert np.isnan(rep.final("no_such_metric"))
+        assert np.isnan(rep.final("no_such_metric", local=True))
+        assert rep.final("accuracy") == pytest.approx(0.7)
+
+    def test_to_dict_is_strict_json(self, tmp_path):
+        rep = self._report(failed_by_cause={
+            "drop": np.array([1, 0, 1]), "offline": np.array([0, 0, 1]),
+            "overflow": np.array([0, 0, 0])})
+        d = rep.to_dict()
+        # allow_nan=False: NaN eval rows must have become nulls.
+        text = json.dumps(d, allow_nan=False)
+        back = json.loads(text)
+        assert back["schema"] == 2
+        assert back["global_evals"][1] == [None]
+        assert back["failed_per_cause"]["drop"] == [1, 0, 1]
+        path = rep.save(str(tmp_path / "report.json"))
+        assert json.load(open(path))["sent_per_round"] == [3, 4, 5]
+
+    def test_concatenate_preserves_causes(self):
+        a = self._report(failed_by_cause={
+            "drop": np.array([1, 0, 1]), "offline": np.array([0, 0, 1]),
+            "overflow": np.array([0, 0, 0])})
+        b = self._report(failed_by_cause={
+            "drop": np.array([2, 2, 0]), "offline": np.array([0, 1, 0]),
+            "overflow": np.array([1, 0, 0])})
+        cat = SimulationReport.concatenate([a, b])
+        assert cat.sent_per_round.shape == (6,)
+        assert cat.failed_per_cause["drop"].tolist() == [1, 0, 1, 2, 2, 0]
+        assert cat.total_size == 24
+        # A segment without causes drops the breakdown rather than lying.
+        c = self._report()
+        assert SimulationReport.concatenate([a, c]).failed_per_cause is None
+
+    def test_attach_wall_clock_ema_skips_cold_round(self):
+        rep = self._report()
+        # Round 1 took 10 s (compile), rounds 2-3 took 0.1 s each.
+        rep.attach_wall_clock(0.0, [10.0, 10.1, 10.2])
+        assert rep.wall_clock_seconds_per_round == pytest.approx(
+            [10.0, 0.1, 0.1])
+        assert rep.rounds_per_sec_ema == pytest.approx(10.0, rel=1e-3)
+
+
+class TestPhaseScopes:
+    def test_compiled_hlo_contains_all_four_scopes(self, key):
+        sim = faulty_sim(n_nodes=12, drop_prob=0.0, online_prob=1.0,
+                         mailbox_slots=2)
+        st = sim.init_nodes(key)
+        txt = sim.lower_start(st, n_rounds=2, key=key).compile().as_text()
+        assert phases_in_text(txt) == list(ROUND_PHASES)
+
+    def test_profiler_trace_contains_scopes(self, tmp_path, key):
+        """Acceptance: an XProf trace captured via profile_dir= carries the
+        named phase scopes."""
+        from gossipy_tpu.telemetry import phases_in_trace_dir
+        sim = faulty_sim(n_nodes=12, drop_prob=0.0, online_prob=1.0,
+                         mailbox_slots=2)
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key,
+                  profile_dir=str(tmp_path / "prof"))
+        found = phases_in_trace_dir(str(tmp_path / "prof"))
+        assert found == list(ROUND_PHASES), found
+
+
+class TestRunManifest:
+    def test_from_simulator_collects_config(self, key):
+        sim = faulty_sim(n_nodes=16)
+        man = sim.run_manifest(extra={"note": "test"})
+        d = man.to_dict()
+        assert d["schema"] == 1
+        assert d["config"]["n_nodes"] == 16
+        assert d["config"]["protocol"] == "PUSH"
+        assert d["config"]["drop_prob"] == pytest.approx(0.3)
+        assert d["backend"]["backend"] == "cpu"
+        assert d["versions"]["jax"] == jax.__version__
+        assert d["memory_budget"]["total_bytes"] > 0
+        assert d["extra"] == {"note": "test"}
+        # Repo checkouts have a git rev; the field is best-effort elsewhere.
+        assert d["git_rev"] is None or isinstance(d["git_rev"], str)
+        json.dumps(d, allow_nan=False)  # strict JSON
+
+    def test_compile_seconds_recorded_after_cold_start(self, key, tmp_path):
+        sim = faulty_sim(n_nodes=12)
+        assert sim.last_compile_seconds is None
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key)
+        assert sim.last_compile_seconds is not None
+        assert sim.last_compile_seconds > 0
+        man = RunManifest.from_simulator(sim)
+        assert man.compile_seconds == sim.last_compile_seconds
+        path = man.save(str(tmp_path / "manifest.json"))
+        assert json.load(open(path))["config"]["n_nodes"] == 12
+
+
+class TestTelemetrySink:
+    def test_mailbox_undersized_emits_event(self):
+        prev = set_sink(TelemetrySink())
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                faulty_sim(n_nodes=32, mailbox_slots=1)
+            evs = get_sink().events(kind="mailbox_undersized")
+            assert len(evs) == 1
+            assert evs[0].data["mailbox_slots"] == 1
+            assert evs[0].data["p_overflow_per_node_round"] > 1e-3
+        finally:
+            set_sink(prev)
+
+    def test_sink_jsonl_mirror_and_ring(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        sink = TelemetrySink(maxlen=2, jsonl_path=path)
+        for i in range(3):
+            sink.emit("k", {"i": i})
+        sink.close()
+        assert [e.data["i"] for e in sink.events()] == [1, 2]  # ring bound
+        rows = [json.loads(l) for l in open(path)]
+        assert [r["data"]["i"] for r in rows] == [0, 1, 2]  # mirror keeps all
+
+
+class Recorder(SimulationEventReceiver):
+    def __init__(self, live=False):
+        self.live = live
+        self.rounds = []
+        self.causes = []
+        self.messages = []
+
+    def update_message(self, round, sent, failed, size):
+        self.messages.append((round, sent, failed))
+
+    def update_failure_causes(self, round, causes):
+        self.causes.append((round, dict(causes)))
+
+    def update_timestep(self, round):
+        self.rounds.append(round)
+
+
+class TestLiveDelivery:
+    """End-to-end coverage of the live io_callback path (satellite)."""
+
+    def test_live_receiver_sees_every_round_in_order(self, key):
+        sim = faulty_sim(n_nodes=12)
+        rec = Recorder(live=True)
+        sim.add_receiver(rec)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=6, key=key)
+        assert rec.rounds == [1, 2, 3, 4, 5, 6]       # every round, in order
+        assert len(rec.rounds) == 6                    # no double delivery
+        # Causes stream live and match the report's arrays per round.
+        assert [r for r, _ in rec.causes] == [1, 2, 3, 4, 5, 6]
+        for i, (_, causes) in enumerate(rec.causes):
+            assert causes["drop"] == rep.failed_per_cause["drop"][i]
+            assert sum(causes.values()) == rep.failed_per_round[i]
+
+    def test_replay_does_not_double_deliver_to_live(self, key):
+        sim = faulty_sim(n_nodes=12)
+        live, replay = Recorder(live=True), Recorder()
+        sim.add_receiver(live)
+        sim.add_receiver(replay)
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=4, key=key)
+        assert live.rounds == replay.rounds == [1, 2, 3, 4]
+        assert live.messages == replay.messages
+        assert live.causes == replay.causes
+
+    def test_live_run_attaches_wall_clock(self, key):
+        sim = faulty_sim(n_nodes=12)
+        sim.add_receiver(Recorder(live=True))
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=5, key=key)
+        assert rep.wall_clock_seconds_per_round is not None
+        assert rep.wall_clock_seconds_per_round.shape == (5,)
+        assert rep.rounds_per_sec_ema > 0
+
+    def test_non_live_run_has_no_wall_clock(self, key):
+        sim = faulty_sim(n_nodes=12)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=3, key=key)
+        assert rep.wall_clock_seconds_per_round is None
+        assert rep.rounds_per_sec_ema is None
+
+
+class TestReceivers:
+    def test_jsonl_rows_carry_schema_and_causes(self, tmp_path, key):
+        sim = faulty_sim(n_nodes=12)
+        path = str(tmp_path / "m.jsonl")
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rx)
+            st = sim.init_nodes(key)
+            st, rep = sim.start(st, n_rounds=4, key=key)
+        rows = [json.loads(l) for l in open(path)]
+        assert len(rows) == 4
+        for i, row in enumerate(rows):
+            assert row["schema"] == 2
+            assert set(row["failed_by_cause"]) == set(FAILURE_CAUSES)
+            assert sum(row["failed_by_cause"].values()) == row["failed"]
+            assert row["failed"] == rep.failed_per_round[i]
+
+    def test_progress_line_shows_throughput_and_fail_rate(self, key,
+                                                          capsys):
+        sim = faulty_sim(n_nodes=12)
+        sim.add_receiver(ProgressReceiver(every=2, metric="accuracy"))
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=4, key=key)
+        out = capsys.readouterr().out
+        lines = [l for l in out.splitlines() if l.startswith("[round")]
+        assert len(lines) == 2
+        assert "r/s" in lines[0] and "failed" in lines[0]
+        assert "%" in lines[0]
