@@ -52,6 +52,12 @@ DEGREE = 20
 # FALLBACK_BASELINE_ROUNDS rounds in 2.62s = 1.14 r/s.
 FALLBACK_BASELINE = 1.14
 FALLBACK_BASELINE_ROUNDS = 3
+# Wire/storage format of the params-history ring (--history-dtype flag):
+# float32 (exact), bfloat16, int8 — see GossipSimulator(history_dtype=...).
+HISTORY_DTYPE = "float32"
+# Wire-traffic stamp filled by the measured run (bytes moved per round under
+# the configured format), merged into the emitted JSON's raw block.
+WIRE_INFO: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -98,6 +104,28 @@ def emit_manifest(sim, mode: str) -> None:
                   file=sys.stderr)
 
 
+def stamp_wire_traffic(sim, report, rounds: int) -> None:
+    """One stderr line + ``WIRE_INFO`` raw fields for the run's wire
+    traffic under the configured ``--history-dtype``: bytes one message
+    moves and the measured bytes-moved-per-round (sent/round x
+    wire_bytes_per_message — the history-ring gather traffic the deliver
+    phase actually pays, quantized payload + int8 scale sidecar)."""
+    try:
+        per_msg = sim.wire_bytes_per_message()
+        per_round = report.sent_messages / max(rounds, 1) * per_msg
+    except Exception as e:  # a stamp failure must not kill a measurement
+        print(f"[bench] wire stamp failed: {e!r}", file=sys.stderr)
+        return
+    WIRE_INFO.update({
+        "history_dtype": sim.history_dtype,
+        "wire_bytes_per_message": int(per_msg),
+        "wire_bytes_per_round": round(per_round, 1),
+    })
+    print(f"[bench] wire: history_dtype={sim.history_dtype}, "
+          f"{per_msg} B/message, ~{per_round:,.0f} bytes moved/round",
+          file=sys.stderr)
+
+
 def make_data():
     """Deterministic spambase-shaped dataset (4601 x 57, binary)."""
     from gossipy_tpu.data import load_classification_dataset
@@ -131,41 +159,47 @@ def build_sim(X, y, fused: bool = False):
                                                    backend="networkx"),
                            disp.stacked(), delta=ROUND_LEN,
                            protocol=AntiEntropyProtocol.PUSH,
-                           fused_merge=fused)
+                           fused_merge=fused,
+                           history_dtype=HISTORY_DTYPE)
 
 
 def bench_ours(X, y) -> float:
     import jax
 
-    def run(fused: bool) -> tuple[float, float, object]:
+    def run(fused: bool) -> tuple[float, float, object, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
         sim = build_sim(X, y, fused)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
-        # Warmup: trigger compilation of the scan.
-        s2, _ = sim.start(state, n_rounds=n_rounds, key=key)
+        # Warmup: trigger compilation of the scan (donate_state=False: the
+        # timed run below restarts from the SAME initial state).
+        s2, _ = sim.start(state, n_rounds=n_rounds, key=key,
+                          donate_state=False)
         jax.block_until_ready(s2.model.params)
         t0 = time.perf_counter()
         s3, report = sim.start(state, n_rounds=n_rounds, key=key)
         jax.block_until_ready(s3.model.params)
         elapsed = time.perf_counter() - t0
-        return elapsed, report.curves(local=False)["accuracy"][-1], sim
+        return elapsed, report.curves(local=False)["accuracy"][-1], sim, \
+            report
 
     n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
-    elapsed, acc, sim = run(False)
+    elapsed, acc, sim, report = run(False)
     label = "plain"
     if jax.default_backend() == "tpu":
         try:  # pallas fused deliver path: keep whichever is faster on this chip
-            elapsed_f, acc_f, sim_f = run(True)
+            elapsed_f, acc_f, sim_f, report_f = run(True)
             print(f"[bench] fused: {n_rounds} rounds in {elapsed_f:.2f}s",
                   file=sys.stderr)
             if elapsed_f < elapsed:
-                elapsed, acc, label, sim = elapsed_f, acc_f, "fused", sim_f
+                elapsed, acc, label, sim, report = \
+                    elapsed_f, acc_f, "fused", sim_f, report_f
         except Exception as e:  # kernel unavailable on this backend
             print(f"[bench] fused path unavailable ({e!r})", file=sys.stderr)
     print(f"[bench] ours ({label}): {n_rounds} rounds in {elapsed:.2f}s "
           f"({n_rounds/elapsed:.1f} r/s), final global acc {acc:.3f}",
           file=sys.stderr)
+    stamp_wire_traffic(sim, report, n_rounds)
     emit_manifest(sim, f"north-star/{label}")
     return n_rounds / elapsed
 
@@ -450,7 +484,8 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
         if flops_total is not None:
             flops_total *= reps
     else:
-        s2, _ = sim.start(state, n_rounds=rounds, key=key)  # warmup/compile
+        s2, _ = sim.start(state, n_rounds=rounds, key=key,  # warmup/compile
+                          donate_state=False)
         jax.block_until_ready(s2.model.params)
         t0 = time.perf_counter()
         s3, _ = sim.start(state, n_rounds=rounds, key=key)
@@ -556,14 +591,16 @@ def _scale_harness(n_nodes: int, rounds: int, build_sim):
     stamp("init_nodes")
     state = sim.init_nodes(key)
     stamp(f"compile+first {rounds}-round run")
-    s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
-    jax.block_until_ready(s2.model.params)
+    s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile; keep the
+                      donate_state=False)               # state for the
+    jax.block_until_ready(s2.model.params)              # timed rerun
     stamp("timed run")
     t0 = time.perf_counter()
     s3, report = sim.start(state, n_rounds=rounds, key=key)
     jax.block_until_ready(s3.model.params)
     elapsed = time.perf_counter() - t0
     stamp("done")
+    stamp_wire_traffic(sim, report, rounds)
     emit_manifest(sim, "scale")
     acc = report.curves(local=False)["accuracy"][-1]
     return rounds / elapsed, float(acc), build_s
@@ -600,7 +637,8 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
         build_s = time.perf_counter() - t0
         sim = GossipSimulator(handler, topo, disp.stacked(), delta=ROUND_LEN,
                               protocol=AntiEntropyProtocol.PUSH,
-                              sampling_eval=0.01, eval_every=rounds)
+                              sampling_eval=0.01, eval_every=rounds,
+                              history_dtype=HISTORY_DTYPE)
         return sim, build_s
 
     rate, acc, build_s = _scale_harness(n_nodes, rounds, build_sim)
@@ -881,7 +919,8 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
                               eval_every=rounds, fused_merge=fused)
         key = jax.random.PRNGKey(0)
         state = sim.init_nodes(key, common_init=True)
-        s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile
+        s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile
+                          donate_state=False)
         jax.block_until_ready(s2.model.params)
         t0 = time.perf_counter()
         s3, _ = sim.start(state, n_rounds=rounds, key=key)
@@ -1174,7 +1213,16 @@ modes (default: the 100-node north-star, ours vs the live reference):
   --to-acc TARGET           wall-clock to reach TARGET global accuracy
   --print-deadline [MODE]   print the mode's watchdog deadline and exit
 
+options (compose with any mode):
+  --history-dtype FMT       params-history ring wire format: float32
+                            (default, exact), bfloat16, int8 — the
+                            quantized ring cuts history_ring_bytes and the
+                            deliver phase's HBM gather traffic 2-4x; the
+                            run stamps bytes-moved-per-round on stderr and
+                            in the JSON raw block
+
 env: GOSSIPY_TPU_BENCH_DEADLINE overrides the watchdog deadline (seconds).
+     GOSSIPY_TPU_COMPILATION_CACHE=1|<dir> persists XLA compilations.
 """
 
 
@@ -1194,6 +1242,17 @@ def main():
         sys.argv.remove("--_accel-inner")
 
     # Parse argv first: usage errors must not pay the backend probe.
+    # --history-dtype composes with every mode (it is NOT removed from
+    # sys.argv: the watchdog/degrade paths re-exec with sys.argv[1:] and
+    # must propagate it to the child).
+    global HISTORY_DTYPE
+    if "--history-dtype" in sys.argv:
+        i = sys.argv.index("--history-dtype")
+        val = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
+        if val not in ("float32", "bfloat16", "int8"):
+            sys.exit("usage: python bench.py [MODE] --history-dtype "
+                     f"{{float32,bfloat16,int8}}; got {val!r}")
+        HISTORY_DTYPE = val
     mode, mode_arg = "north-star", None
     if "--mfu-all2all" in sys.argv:
         mode, mode_arg = "mfu-all2all", _mode_arg("--mfu-all2all",
@@ -1297,6 +1356,7 @@ def main():
         "unit": "rounds/s",
         "vs_baseline": round(ours / baseline, 2),
         "raw": {
+            **WIRE_INFO,
             "ours_rounds_per_sec": round(ours, 2),
             "ours_rounds_measured": (BENCH_ROUNDS_DEGRADED if DEGRADED
                                      else BENCH_ROUNDS),

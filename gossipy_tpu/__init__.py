@@ -70,6 +70,43 @@ def set_seed(seed: int = 42) -> jax.Array:
     return jax.random.PRNGKey(seed)
 
 
+# Persistent-compilation-cache bookkeeping: the active cache dir (None =
+# not enabled through this package) and per-event counters harvested from
+# jax.monitoring ('/jax/compilation_cache/cache_hits' etc.) — surfaced in
+# the telemetry RunManifest so a run record says whether its cold compile
+# was a disk load or a real XLA compile.
+_COMPILATION_CACHE_DIR: str | None = None
+_COMPILATION_CACHE_EVENTS: dict[str, int] = {}
+_CACHE_LISTENER_REGISTERED = False
+
+
+def _register_cache_listener() -> None:
+    global _CACHE_LISTENER_REGISTERED
+    if _CACHE_LISTENER_REGISTERED:
+        return
+
+    def listener(event: str, **kwargs) -> None:
+        if "compilation_cache" in event:
+            short = event.rsplit("/", 1)[-1]
+            _COMPILATION_CACHE_EVENTS[short] = \
+                _COMPILATION_CACHE_EVENTS.get(short, 0) + 1
+
+    try:
+        jax.monitoring.register_event_listener(listener)
+        _CACHE_LISTENER_REGISTERED = True
+    except Exception:  # monitoring API drift must not break imports
+        pass
+
+
+def compilation_cache_stats() -> dict:
+    """Where the persistent compilation cache points and what it did so far
+    this process: ``{"enabled": bool, "dir": path|None, "events":
+    {"cache_hits": n, ...}}``. Recorded in every RunManifest."""
+    return {"enabled": _COMPILATION_CACHE_DIR is not None,
+            "dir": _COMPILATION_CACHE_DIR,
+            "events": dict(_COMPILATION_CACHE_EVENTS)}
+
+
 def enable_compilation_cache(path: str | None = None) -> str:
     """Enable JAX's persistent compilation cache.
 
@@ -77,17 +114,38 @@ def enable_compilation_cache(path: str | None = None) -> str:
     fresh process; with the cache, re-runs of the same config (benchmarks,
     resumed experiments, the example scripts) load the compiled binary in
     milliseconds. Defaults to ``~/.cache/gossipy_tpu_xla``.
+
+    Also opt-in via the environment: setting ``GOSSIPY_TPU_COMPILATION_CACHE``
+    enables the cache at package import — ``1``/``true`` selects the default
+    directory, any other value is used as the cache path. Cache hits are
+    counted (jax.monitoring) and stamped into the RunManifest via
+    :func:`compilation_cache_stats`.
     """
     import os
+    global _COMPILATION_CACHE_DIR
     path = path or os.path.join(os.path.expanduser("~"), ".cache",
                                 "gossipy_tpu_xla")
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _COMPILATION_CACHE_DIR = path
+        _register_cache_listener()
     except OSError as e:  # read-only HOME etc. — the cache is best-effort
         LOG.warning("compilation cache disabled (%s unwritable: %s)", path, e)
     return path
+
+
+def _maybe_enable_cache_from_env() -> None:
+    import os
+    val = os.environ.get("GOSSIPY_TPU_COMPILATION_CACHE", "").strip()
+    if not val or val.lower() in ("0", "false", "no"):
+        return
+    enable_compilation_cache(
+        None if val.lower() in ("1", "true", "yes") else val)
+
+
+_maybe_enable_cache_from_env()
 
 
 class GlobalSettings:

@@ -22,6 +22,12 @@ restores with its shardings when ``template`` carries them.
 Compatibility note: a restore target must be built with the SAME simulator
 configuration, including ``mailbox_slots`` — the mailbox is a [D, N, K]
 state array and a template with a different K cannot receive the snapshot.
+``history_dtype`` is part of that contract too: the params-history ring is
+checkpointed in its wire format (bf16/int8 rings round-trip at their
+reduced size, the int8 scale sidecar rides along as ``history_scale``),
+and a template built with a different format has mismatching ring dtypes/
+tree structure. Quantize-on-snapshot means converting a checkpoint between
+formats is a state transform, not a restore-time cast.
 Since round 4 the default ``mailbox_slots=None`` DERIVES K from the
 topology (Poisson fan-in bound; engine.py), so on hub-heavy topologies the
 derived K can differ from the old fixed default: pin ``mailbox_slots=6``
@@ -32,6 +38,7 @@ derived mailbox drops fewer overflow messages).
 
 from __future__ import annotations
 
+import inspect
 import os
 from typing import Any, Optional
 
@@ -151,6 +158,11 @@ class CheckpointManager:
         the interval for big models.
         """
         newest = self.latest()
+        # Buffer-donation bookkeeping: the chunk loop donates its input
+        # state to each jitted run (the ring is not double-buffered), but
+        # NEVER the caller's own pytree — when no checkpoint was restored,
+        # the first chunk's input is caller-owned and must stay alive.
+        caller_owned = newest is None
         if newest is not None:
             state, saved_key = restore_checkpoint(self._path(newest), state, key)
             if saved_key is not None:
@@ -158,9 +170,13 @@ class CheckpointManager:
         start_round = int(np.asarray(state.round))
         done = 0
         target = until_round - start_round
+        # The sequential (eager) engine's start() has no donation knob.
+        donatable = "donate_state" in inspect.signature(sim.start).parameters
         while done < target:
             chunk = min(self.interval, target - done)
-            state, report = sim.start(state, n_rounds=chunk, key=key)
+            kw = ({"donate_state": not caller_owned} if donatable else {})
+            state, report = sim.start(state, n_rounds=chunk, key=key, **kw)
+            caller_owned = False
             if reports is not None:
                 reports.append(report)
             done += chunk

@@ -371,11 +371,16 @@ def _synthetic_classification(name: str, n: int, d: int, c: int,
     # Min pairwise center distance governs the hardest class confusion; the
     # multiclass ceiling sits slightly above Phi(sep/2) because most pairs
     # land farther apart than the closest one.
-    diffs = centers[:, None, :] - centers[None, :, :]
-    dists = np.sqrt((diffs ** 2).sum(-1))
-    np.fill_diagonal(dists, np.inf)
-    sep = 2.0 * NormalDist().inv_cdf(bayes_accuracy)
-    centers *= sep / dists.min()
+    if c > 1:
+        # Separation calibration needs a closest PAIR; with c == 1 the
+        # diagonal-filled distance matrix is all-inf and the rescale would
+        # silently zero the single center (sep / inf) — skip it, the
+        # one-class problem has no Bayes-accuracy knob to calibrate.
+        diffs = centers[:, None, :] - centers[None, :, :]
+        dists = np.sqrt((diffs ** 2).sum(-1))
+        np.fill_diagonal(dists, np.inf)
+        sep = 2.0 * NormalDist().inv_cdf(bayes_accuracy)
+        centers *= sep / dists.min()
     per = n // c
     Xs, ys = [], []
     for k in range(c):

@@ -23,6 +23,14 @@ block dim must be 8-divisible OR equal the array dim) the operands carry a
 unit middle axis — ``[rows, 1, features]`` with ``(1, 1, block_f)`` blocks.
 Off-TPU the same kernel runs in interpreter mode (used by the CPU test
 mesh).
+
+Quantized history rings (``GossipSimulator(history_dtype=...)``) store ``h``
+in a reduced-precision wire format — bf16 (plain cast) or int8 with a
+symmetric per-row scale sidecar. The dequantizing kernel variant widens the
+peer block to the receiver dtype INSIDE the kernel (and applies the
+scalar-prefetched per-receiver scale for int8), so the fp32 peer copy is
+never materialized in HBM: the gather moves 2-4x fewer bytes and the merge
+math stays fp32.
 """
 
 from __future__ import annotations
@@ -54,15 +62,33 @@ def _kernel(idx_ref, w_self_ref, w_peer_ref, p_ref, h_ref, o_ref):
     o_ref[:] = w_self_ref[i] * p_ref[:] + w_peer_ref[i] * h_ref[:]
 
 
+def _dq_kernel(idx_ref, w_self_ref, w_peer_ref, scale_ref, p_ref, h_ref,
+               o_ref):
+    # Dequantizing variant: the history block arrives in its wire dtype
+    # (bf16/int8) and is widened to the receiver dtype in VMEM; for int8
+    # the per-receiver scale (already gathered host-of-kernel to [N]) is a
+    # scalar-prefetch operand. scale == 1 for bf16.
+    i = pl.program_id(0)
+    peer = h_ref[:].astype(o_ref.dtype) * scale_ref[i]
+    o_ref[:] = w_self_ref[i] * p_ref[:] + w_peer_ref[i] * peer
+
+
 def gather_merge_reference(p: jax.Array, h: jax.Array, idx: jax.Array,
-                           w_self: jax.Array, w_peer: jax.Array) -> jax.Array:
-    """jnp fallback: materializes the gather (what XLA does un-fused)."""
-    peer = h[idx]
+                           w_self: jax.Array, w_peer: jax.Array,
+                           scale: Optional[jax.Array] = None) -> jax.Array:
+    """jnp fallback: materializes the gather (what XLA does un-fused).
+
+    ``scale`` is the optional [M] per-history-row dequantization scale
+    (int8 wire format); bf16 rows dequantize by the plain cast.
+    """
+    peer = h[idx].astype(p.dtype)
+    if scale is not None:
+        peer = peer * scale[idx].astype(p.dtype)[:, None]
     return w_self[:, None] * p + w_peer[:, None] * peer
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "block_f"))
-def _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret: bool,
+def _gather_merge_pallas(p, h, idx, w_self, w_peer, scale, interpret: bool,
                          block_f: int):
     n, f = p.shape
     pad = (-f) % block_f
@@ -72,6 +98,35 @@ def _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret: bool,
     fp = f + pad
     p3 = p.reshape(n, 1, fp)
     h3 = h.reshape(h.shape[0], 1, fp)
+    dequant = (h.dtype != p.dtype) or (scale is not None)
+
+    if dequant:
+        # Per-RECEIVER scale: gathering scale[idx] outside the kernel keeps
+        # the scalar-prefetch operand at [N] (one SMEM word per grid row)
+        # instead of the whole [M] sidecar, and spares the kernel a second
+        # indirection. Ones when the wire format needs only the cast (bf16).
+        scale_g = (jnp.ones((n,), p.dtype) if scale is None
+                   else scale[idx].astype(p.dtype))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(n, fp // block_f),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_f),
+                             lambda i, j, s, w1, w2, sc: (i, 0, j)),
+                pl.BlockSpec((1, 1, block_f),
+                             lambda i, j, s, w1, w2, sc: (s[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_f),
+                                   lambda i, j, s, w1, w2, sc: (i, 0, j)),
+        )
+        out = pl.pallas_call(
+            _dq_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n, 1, fp), p.dtype),
+            interpret=interpret,
+        )(idx.astype(jnp.int32), w_self.astype(p.dtype),
+          w_peer.astype(p.dtype), scale_g, p3, h3)
+        return out.reshape(n, fp)[:, :f] if pad else out.reshape(n, fp)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -94,37 +149,48 @@ def _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret: bool,
 
 def gather_merge_flat(p: jax.Array, h: jax.Array, idx: jax.Array,
                       w_self: jax.Array, w_peer: jax.Array,
+                      scale: Optional[jax.Array] = None,
                       interpret: Optional[bool] = None,
                       block_f: int = BLOCK_F) -> jax.Array:
-    """``out[i] = w_self[i] * p[i] + w_peer[i] * h[idx[i]]``.
+    """``out[i] = w_self[i] * p[i] + w_peer[i] * dequant(h[idx[i]])``.
 
     ``p`` is [N, F]; ``h`` is [M, F] (e.g. the [D*N, F]-flattened history
-    ring); ``idx`` int32 [N] in [0, M); weights are [N]. ``interpret=None``
-    auto-selects interpreter mode off-TPU.
+    ring) in fp32 or a wire format (bf16/int8 — dequantized inside the
+    kernel, the fp32 peer copy never touches HBM); ``idx`` int32 [N] in
+    [0, M); weights are [N]; ``scale`` optional [M] per-row dequant scales
+    (required semantics for int8 rings). ``interpret=None`` auto-selects
+    interpreter mode off-TPU.
     """
     if not _HAS_PALLAS:
-        return gather_merge_reference(p, h, idx, w_self, w_peer)
+        return gather_merge_reference(p, h, idx, w_self, w_peer, scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _gather_merge_pallas(p, h, idx, w_self, w_peer, interpret,
+    return _gather_merge_pallas(p, h, idx, w_self, w_peer, scale, interpret,
                                 int(block_f))
 
 
 def gather_merge_pytree(params, history, flat_idx: jax.Array,
                         w_self: jax.Array, w_peer: jax.Array,
-                        interpret: Optional[bool] = None):
-    """Leafwise fused gather-merge over a stacked params pytree.
+                        scales=None, interpret: Optional[bool] = None):
+    """Leafwise fused (dequantizing) gather-merge over a stacked params pytree.
 
     ``params`` leaves are [N, ...]; ``history`` leaves are [D, N, ...]
-    (the engine's snapshot ring); ``flat_idx[i] = (send_round_i % D) * N +
-    sender_i`` addresses the ring as a flat [D*N, F] table.
+    (the engine's snapshot ring, fp32 or a wire format); ``flat_idx[i] =
+    (send_round_i % D) * N + sender_i`` addresses the ring as a flat
+    [D*N, F] table. ``scales`` is the optional matching pytree of [D, N]
+    per-(round-slot, node, leaf) dequant scales (int8 rings).
     """
-    def leaf(pl_, hl):
+    def leaf(pl_, hl, sl=None):
         n = pl_.shape[0]
         f = int(np.prod(pl_.shape[1:])) if pl_.ndim > 1 else 1
+        flat_scale = (None if sl is None
+                      else sl.reshape(sl.shape[0] * sl.shape[1]))
         out = gather_merge_flat(pl_.reshape(n, f),
                                 hl.reshape(hl.shape[0] * hl.shape[1], f),
-                                flat_idx, w_self, w_peer, interpret=interpret)
+                                flat_idx, w_self, w_peer, scale=flat_scale,
+                                interpret=interpret)
         return out.reshape(pl_.shape)
 
-    return jax.tree.map(leaf, params, history)
+    if scales is None:
+        return jax.tree.map(leaf, params, history)
+    return jax.tree.map(leaf, params, history, scales)

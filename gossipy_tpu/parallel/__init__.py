@@ -271,11 +271,14 @@ def state_shardings(state: SimState, mesh: Mesh,
     mb_sh = jax.tree.map(lambda l: shard(l, 1), state.mailbox)
     rb_sh = jax.tree.map(lambda l: shard(l, 1), state.reply_box)
     aux_sh = jax.tree.map(lambda l: shard(l, 0), state.aux)
+    # int8 ring sidecar: [D, N] per leaf — node axis at position 1, like
+    # the history ring itself (empty tuple for fp32/bf16 rings).
+    hist_s_sh = jax.tree.map(lambda l: shard(l, 1), state.history_scale)
     return SimState(model=model_sh, phase=phase_sh,
                     history_params=hist_p_sh, history_ages=hist_a_sh,
                     mailbox=mb_sh, reply_box=rb_sh,
                     round=NamedSharding(mesh, P()),
-                    aux=aux_sh)
+                    aux=aux_sh, history_scale=hist_s_sh)
 
 
 def shard_state(state: SimState, mesh: Mesh,

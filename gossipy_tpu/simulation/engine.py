@@ -216,6 +216,8 @@ class SimState(NamedTuple):
     model: ModelState        # stacked [N, ...]
     phase: jax.Array         # [N] per-node timing (offset or period)
     history_params: Any      # pytree [D, N, ...] round-start snapshots
+                             # (stored in the simulator's history_dtype
+                             # wire format; fp32 by default)
     history_ages: jax.Array  # [D, N(, P)] snapshot ages
     mailbox: Mailbox         # push/pull traffic
     reply_box: Mailbox       # REPLY traffic (reference rep_queues)
@@ -223,6 +225,10 @@ class SimState(NamedTuple):
     aux: Any = ()            # variant-specific node state (token balances,
                              # neighbor caches, PENS counters, ...) with
                              # leading node axis on every leaf
+    history_scale: Any = ()  # int8 wire format only: pytree matching
+                             # history_params with [D, N] f32 symmetric
+                             # dequant scales per (round-slot, node, leaf);
+                             # () for float32/bfloat16 rings
 
 
 def _rank_within_group(key_arr: jax.Array) -> jax.Array:
@@ -314,7 +320,29 @@ class GossipSimulator(SimulationEventSender):
         :meth:`run_repetitions` always runs its seed-vmapped program with
         compaction off — a vmapped ``lax.cond`` predicate executes both
         branches, which would ADD the compact pass to every wide one.
+    history_dtype : str
+        Wire/storage format of the params-history ring — what a message's
+        payload snapshot is stored (and therefore gathered) as:
+        ``"float32"`` (default; bit-identical to storing the params
+        directly), ``"bfloat16"`` (plain cast, 2x smaller ring and deliver
+        gather), or ``"int8"`` (symmetric per-(round-slot, node, leaf)
+        scales in a small [D, N]-per-leaf sidecar, quantize-on-snapshot /
+        dequantize-on-gather, ~4x smaller). The ring is the dominant
+        persistent state term (``memory_budget()["history_ring_bytes"]``)
+        and the deliver phase's HBM traffic, so reduced formats raise the
+        max population / ring depth on a fixed chip; they also model real
+        gossip wire compression. Merge math always runs in fp32 — only the
+        stored snapshot is low-precision.
     """
+
+    # Out-of-tree subclasses that override ``_decode_extra`` or
+    # ``_receive_rows`` must declare compaction safety explicitly (the
+    # row-aligned/elementwise contract documented on those hooks) by
+    # setting ``_compact_safe = True`` before compact delivery auto-enables
+    # for them. In-tree variants set it; the base pipeline needs no flag.
+    _compact_safe: bool = False
+
+    _HISTORY_DTYPES = ("float32", "bfloat16", "int8")
 
     def __init__(self,
                  handler: BaseHandler,
@@ -333,8 +361,14 @@ class GossipSimulator(SimulationEventSender):
                  message_size: Optional[int] = None,
                  fused_merge: bool = False,
                  compact_deliver: Optional[bool] = None,
-                 max_fires_per_round: Optional[int] = None):
+                 max_fires_per_round: Optional[int] = None,
+                 history_dtype: str = "float32"):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
+        if history_dtype not in self._HISTORY_DTYPES:
+            raise ValueError(
+                f"unknown history_dtype {history_dtype!r}; options: "
+                + ", ".join(self._HISTORY_DTYPES))
+        self.history_dtype = history_dtype
         self.handler = handler
         self.topology = topology
         self.n_nodes = topology.num_nodes
@@ -387,24 +421,38 @@ class GossipSimulator(SimulationEventSender):
         # through [cap]-shaped sub-batches; like fused_merge it is only
         # valid when the pipeline pieces are the base ones. Supported
         # customization points under compaction: _decode_extra (the
-        # decoded arg is gathered; every in-tree override is elementwise)
-        # and _receive_rows (row-aligned by contract). _gather_peer /
-        # _apply_receive overrides may read full-width positional state
-        # and disable it.
+        # decoded arg is gathered) and _receive_rows (row-aligned by
+        # contract) — but because that row-aligned/elementwise contract
+        # cannot be verified mechanically, a subclass overriding either
+        # must DECLARE safety via the ``_compact_safe`` class attribute
+        # before the auto default enables compaction (every in-tree
+        # override does). _gather_peer / _apply_receive overrides may read
+        # full-width positional state and disable it outright.
         base_receive = all(
             getattr(type(self), hook) is getattr(GossipSimulator, hook)
             for hook in ("_apply_receive", "_gather_peer"))
+        extra_base = all(
+            getattr(type(self), hook) is getattr(GossipSimulator, hook)
+            for hook in ("_decode_extra", "_receive_rows"))
+        compact_ok = base_receive and (extra_base or type(self)._compact_safe)
         if compact_deliver is None:
             # K == 1 means a single slot-0 pass whose typical occupancy
             # (~1-e^-lam of the population) exceeds any useful capacity —
             # and covers All2All, which pins one slot and never reads it.
-            compact_deliver = (base_receive and not self.fused_merge
+            compact_deliver = (compact_ok and not self.fused_merge
                                and self.n_nodes >= 48 and self.K > 1)
         elif compact_deliver:
             assert base_receive, \
                 "compact_deliver requires the base _apply_receive/" \
                 f"_gather_peer (overridden by {type(self).__name__}); " \
                 "pass compact_deliver=False or None"
+            assert extra_base or type(self)._compact_safe, \
+                f"{type(self).__name__} overrides _decode_extra/" \
+                "_receive_rows without declaring _compact_safe = True; " \
+                "compaction gathers those hooks' inputs row-wise and is " \
+                "only correct for row-aligned/elementwise overrides — set " \
+                "the attribute after checking the contract, or pass " \
+                "compact_deliver=False"
             assert not self.fused_merge, \
                 "compact_deliver and fused_merge are mutually exclusive " \
                 "deliver paths"
@@ -653,7 +701,20 @@ class GossipSimulator(SimulationEventSender):
         D = self._history_depth(self._model_size(jax.tree.map(
             lambda l: jax.ShapeDtypeStruct((1,) + l.shape, l.dtype),
             st.params)))
-        per_node_params = leaf_bytes(st.params)
+        # The ring stores snapshots in the history_dtype wire format; the
+        # int8 sidecar (one f32 scale per (round-slot, node, leaf)) is part
+        # of the ring's footprint and included in its term (and reported
+        # separately under a non-``_bytes`` key so the total doesn't count
+        # it twice).
+        n_scalars, n_leaves = self._history_param_counts()
+        sidecar = (4 * D * n * n_leaves
+                   if self.history_dtype == "int8" else 0)
+        if self.history_dtype == "float32":
+            # Identity storage: the ring carries the params' OWN dtypes
+            # (which need not be fp32 for exotic models).
+            ring_bytes = D * n * leaf_bytes(st.params)
+        else:
+            ring_bytes = D * n * n_scalars * self._wire_itemsize() + sidecar
         stacked = jax.tree.map(
             lambda l: jax.ShapeDtypeStruct((n,) + l.shape, l.dtype), st)
         try:
@@ -668,7 +729,9 @@ class GossipSimulator(SimulationEventSender):
         eval_b = self._eval_peak_bytes()
         out = {
             "model_and_opt_bytes": per_node_model * n,
-            "history_ring_bytes": D * n * per_node_params,
+            "history_ring_bytes": ring_bytes,
+            "history_ring_sidecar": sidecar,
+            "history_dtype": self.history_dtype,
             "history_ages_bytes": D * n * leaf_bytes(ages),
             "history_depth": D,
             "aux_bytes": aux_b,
@@ -683,6 +746,84 @@ class GossipSimulator(SimulationEventSender):
 
     def _local_data(self):
         return (self.data["xtr"], self.data["ytr"], self.data["mtr"])
+
+    # -- history wire format -------------------------------------------------
+
+    def _wire_itemsize(self) -> int:
+        """Bytes per stored history scalar under the configured format."""
+        return {"float32": 4, "bfloat16": 2, "int8": 1}[self.history_dtype]
+
+    def _encode_history_rows(self, params):
+        """Encode a params pytree (leaves [..., N, *leaf]) into the history
+        wire format. Returns ``(stored, scales)``: ``stored`` has the same
+        treedef with wire-dtype leaves; ``scales`` is the matching pytree of
+        per-row f32 scales for int8 (leaf shape = leaf.shape minus the
+        trailing feature dims), or ``()`` otherwise. float32 is the
+        identity — the default path stays bit-identical to storing params
+        directly."""
+        if self.history_dtype == "float32":
+            return params, ()
+        if self.history_dtype == "bfloat16":
+            return jax.tree.map(lambda l: l.astype(jnp.bfloat16), params), ()
+
+        # int8: symmetric per-(node-row, leaf) scale over the trailing
+        # (feature) axes. A leaf arrives as [N, *feat] from _snapshot or
+        # [N, S, *feat]... — the convention here is ONE leading row axis:
+        # callers reshape/park per row, so reduce over axes >= 1.
+        def amax_scale(l):
+            red = tuple(range(1, l.ndim))
+            amax = jnp.max(jnp.abs(l), axis=red) if red else jnp.abs(l)
+            # Zero rows (fresh zero-init leaves) get scale 1: q = 0 either
+            # way, and the dequant multiply stays finite.
+            return jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+
+        def quant(l, s):
+            sb = s.reshape(s.shape + (1,) * (l.ndim - s.ndim))
+            q = jnp.round(l.astype(jnp.float32) / sb)
+            return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+        scales = jax.tree.map(amax_scale, params)
+        return jax.tree.map(quant, params, scales), scales
+
+    def _decode_history_rows(self, stored, scales):
+        """Inverse of :meth:`_encode_history_rows` (fp32 out). ``scales``
+        leaf shapes must broadcast against the stored leaves' leading
+        axes."""
+        if self.history_dtype == "float32":
+            return stored
+        if self.history_dtype == "bfloat16":
+            return jax.tree.map(lambda l: l.astype(jnp.float32), stored)
+        return jax.tree.map(
+            lambda q, s: q.astype(jnp.float32)
+            * s.reshape(s.shape + (1,) * (q.ndim - s.ndim)),
+            stored, scales)
+
+    def _wire_roundtrip(self, params):
+        """Encode-then-decode a params pytree through the wire format: what
+        a RECEIVER sees of these params after transport. Identity for fp32;
+        the quantization noise model for bf16/int8 (All2All's broadcast
+        merge uses this — it has no history gather to decode through)."""
+        stored, scales = self._encode_history_rows(params)
+        return self._decode_history_rows(stored, scales)
+
+    def _history_param_counts(self) -> tuple[int, int]:
+        """(per-node param scalar count, leaf count) from a shape-only
+        handler init — shared by :meth:`memory_budget` and
+        :meth:`wire_bytes_per_message` so the two cannot drift."""
+        st = jax.eval_shape(self.handler.init, jax.random.PRNGKey(0))
+        leaves = jax.tree_util.tree_leaves(st.params)
+        return (sum(int(np.prod(l.shape)) for l in leaves), len(leaves))
+
+    def wire_bytes_per_message(self) -> int:
+        """Bytes one model-carrying message moves under the configured wire
+        format: the quantized payload plus, for int8, one f32 scale per
+        parameter leaf. The report's ``size`` column stays in scalars (the
+        reference's unit); this is the bytes view of the same traffic —
+        ``bench.py`` stamps ``sent/round * wire_bytes_per_message()`` as
+        bytes-moved-per-round."""
+        n_scalars, n_leaves = self._history_param_counts()
+        sidecar = 4 * n_leaves if self.history_dtype == "int8" else 0
+        return n_scalars * self._wire_itemsize() + sidecar
 
     def _model_size(self, params) -> int:
         if self._message_size is not None:
@@ -730,8 +871,11 @@ class GossipSimulator(SimulationEventSender):
             phase = jnp.maximum(raw.astype(jnp.int32), 1)
 
         D = self._history_depth(self._model_size(model.params))
-        hist_p = jax.tree.map(
-            lambda l: jnp.broadcast_to(l[None], (D,) + l.shape).copy(), model.params)
+        stored, scales = self._encode_history_rows(model.params)
+        bcast = lambda l: jnp.broadcast_to(l[None], (D,) + l.shape).copy()
+        hist_p = jax.tree.map(bcast, stored)
+        hist_s = (jax.tree.map(bcast, scales)
+                  if self.history_dtype == "int8" else ())
         hist_a = jnp.broadcast_to(model.n_updates[None],
                                   (D,) + model.n_updates.shape).copy()
         return SimState(
@@ -743,6 +887,7 @@ class GossipSimulator(SimulationEventSender):
             reply_box=Mailbox.empty(D, n, self.Kr),
             round=jnp.int32(0),
             aux=self._init_aux(model, key),
+            history_scale=hist_s,
         )
 
     def _init_aux(self, model: ModelState, key: jax.Array):
@@ -870,11 +1015,17 @@ class GossipSimulator(SimulationEventSender):
         return state, n_sent, fails, n_sent * size
 
     def _gather_peer(self, state: SimState, send_round, sender):
-        """Fetch the snapshot a message carries: history[send_round % D][sender]."""
+        """Fetch the snapshot a message carries: history[send_round % D][sender],
+        dequantized from the ring's wire format back to fp32 (the merge math
+        never sees the storage dtype)."""
         D = state.history_ages.shape[0]
         b = send_round % D
         s = jnp.clip(sender, 0, self.n_nodes - 1)
         params = jax.tree.map(lambda h: h[b, s], state.history_params)
+        if self.history_dtype != "float32":
+            scales = (jax.tree.map(lambda sc: sc[b, s], state.history_scale)
+                      if self.history_dtype == "int8" else ())
+            params = self._decode_history_rows(params, scales)
         ages = state.history_ages[b, s]
         return PeerModel(params, ages)
 
@@ -985,8 +1136,14 @@ class GossipSimulator(SimulationEventSender):
         flat_idx = ((send_round % D) * n + s).astype(jnp.int32)
         w_peer = jnp.where(valid, 0.5, 0.0).astype(jnp.float32)
         w_self = 1.0 - w_peer
+        # Quantized rings dequantize INSIDE the kernel (bf16: widen the DMA'd
+        # block; int8: scalar-prefetched per-row scales) — the fp32 peer copy
+        # still never materializes in HBM.
+        scales = (state.history_scale if self.history_dtype == "int8"
+                  else None)
         merged_params = gather_merge_pytree(
-            state.model.params, state.history_params, flat_idx, w_self, w_peer)
+            state.model.params, state.history_params, flat_idx, w_self,
+            w_peer, scales=scales)
         peer_ages = state.history_ages[send_round % D, s]
         merged = ModelState(merged_params, state.model.opt_state,
                             jnp.maximum(state.model.n_updates, peer_ages))
@@ -1248,10 +1405,15 @@ class GossipSimulator(SimulationEventSender):
     def _snapshot(self, state: SimState, r):
         D = state.history_ages.shape[0]
         b = r % D
+        stored, scales = self._encode_history_rows(state.model.params)
         hist_p = jax.tree.map(lambda h, p: h.at[b].set(p),
-                              state.history_params, state.model.params)
+                              state.history_params, stored)
         hist_a = state.history_ages.at[b].set(state.model.n_updates)
-        return state._replace(history_params=hist_p, history_ages=hist_a)
+        state = state._replace(history_params=hist_p, history_ages=hist_a)
+        if self.history_dtype == "int8":
+            state = state._replace(history_scale=jax.tree.map(
+                lambda h, s: h.at[b].set(s), state.history_scale, scales))
+        return state
 
     def _round(self, state: SimState, base_key: jax.Array, last_round=None):
         r = state.round
@@ -1408,13 +1570,22 @@ class GossipSimulator(SimulationEventSender):
 
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None,
-              profile_dir: Optional[str] = None) -> tuple[SimState, SimulationReport]:
+              profile_dir: Optional[str] = None,
+              donate_state: bool = True) -> tuple[SimState, SimulationReport]:
         """Run ``n_rounds`` rounds (reference simul.py:366-458) as one
         ``lax.scan``; returns the final state and a report.
 
         ``profile_dir`` wraps the run in a ``jax.profiler`` trace (SURVEY §5:
         the reference has no tracing; per-round hooks attach via the event
         stream, see :mod:`gossipy_tpu.simulation.events`).
+
+        ``donate_state`` (default True) donates the input state pytree to
+        the compiled program (``donate_argnums``): XLA aliases the output
+        state's buffers onto the input's, so the params-history ring — the
+        dominant persistent term — is not double-buffered across the call.
+        The donated input is INVALIDATED; pass ``donate_state=False`` when
+        you reuse the same initial state for several runs (A/B comparisons,
+        warmup-then-measure).
         """
         if key is None:
             key = jax.random.PRNGKey(42)
@@ -1429,10 +1600,13 @@ class GossipSimulator(SimulationEventSender):
                 "replay — all events still arrive, just not during the run")
             live = False
         first_round = int(np.asarray(state.round))
-        cache_k = ("start", n_rounds, self._cache_salt(), live)
+        cache_k = ("start", n_rounds, self._cache_salt(), live,
+                   bool(donate_state))
         cold = cache_k not in self._jit_cache
         if cold:
-            self._jit_cache[cache_k] = jax.jit(self._make_run(n_rounds, live))
+            self._jit_cache[cache_k] = jax.jit(
+                self._make_run(n_rounds, live),
+                donate_argnums=(0,) if donate_state else ())
 
         import time as _time
         # Live runs get host wall-clock samples per round boundary (the
@@ -1506,6 +1680,12 @@ class GossipSimulator(SimulationEventSender):
         ``start`` per seed when you need the event stream. Single-controller
         only (the seed batch closes over the data; on a multi-host cluster
         run :meth:`start` per seed instead).
+
+        Buffer-donation note: the per-seed states are CREATED inside the
+        compiled program (only the [S] key batch crosses the boundary), so
+        there is no state pytree to donate here — the scan carry already
+        reuses its buffers. :meth:`start` (and PENS's two-segment
+        continuation) donate their state arguments instead.
         """
         assert not self._receivers_list(), \
             "run_repetitions does not support event receivers; use start()"

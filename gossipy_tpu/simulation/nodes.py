@@ -25,13 +25,23 @@ _K_CACHE_POP = 9500    # CacheNeigh: which parked slot to pop
 _K_CACHE_MERGE = 9501  # CacheNeigh: merge-update randomness
 
 
-def build_neighbor_table(topology) -> np.ndarray:
+def build_neighbor_table(topology, reject_duplicates: bool = False) -> np.ndarray:
     """Padded out-neighbor table ``[N, max_deg]`` int32, -1 = unused slot.
 
     The O(N * max_deg) replacement for dense [N, N] per-peer state: variant
     counters/caches key on the slot position of a peer in its row (CacheNeigh
     model slots, PENS selection counters). Works for both dense and CSR
     topologies.
+
+    ``reject_duplicates`` (opt-in; round-5 advisor): slot-KEYED consumers
+    (PENS/CacheNeigh) assume each peer occupies exactly one slot of its
+    receiver's row — a multigraph row would double-count slot matches, so
+    they pass True and a duplicated CSR neighbor raises. Plain neighbor-LIST
+    consumers (the sequential engine's peer sampling) leave it False: there
+    a duplicate edge is harmless and keeps the reference's multigraph
+    semantics (it just raises that peer's sampling weight). Dense
+    adjacencies cannot express duplicates either way (``np.nonzero`` yields
+    unique pairs).
     """
     from ..core import SparseTopology
     n = topology.num_nodes
@@ -46,12 +56,7 @@ def build_neighbor_table(topology) -> np.ndarray:
         i, j = np.nonzero(np.asarray(topology.adjacency))
         pos = np.arange(len(i)) - np.searchsorted(i, i, side="left")
         nbr_table[i, pos] = j
-    # Slot-keyed counters (PENS hit counts, CacheNeigh model slots) assume
-    # each peer occupies exactly ONE slot of its receiver's row; a
-    # multigraph row would double-count matches (round-4 advisor). Dense
-    # adjacencies cannot express duplicates (np.nonzero yields unique
-    # pairs); CSR rows can, so reject them up front.
-    if isinstance(topology, SparseTopology) and n:
+    if reject_duplicates and isinstance(topology, SparseTopology) and n:
         row_sorted = np.sort(nbr_table, axis=1)
         dup = (row_sorted[:, 1:] >= 0) & (row_sorted[:, 1:] == row_sorted[:, :-1])
         if dup.any():
@@ -70,6 +75,11 @@ class PassThroughGossipSimulator(GossipSimulator):
     probability ``min(1, deg_sender / deg_receiver)`` and otherwise adopts
     the received model unmodified (PASS), hiding power-law degree bias.
     """
+
+    # _decode_extra is elementwise and _receive_rows reads per-node state
+    # via node_ids / per-row keys only — compaction-safe by the engine
+    # contract.
+    _compact_safe = True
 
     def _send_extra(self, key, state):
         return self.topology.degrees_dev.astype(jnp.int32)
@@ -107,6 +117,7 @@ class SamplingGossipSimulator(GossipSimulator):
     ``sample_size`` float; a PRNG seed is the constant-size equivalent.
     """
 
+    _compact_safe = True  # _decode_extra is an elementwise vmapped fold_in
     _SAMPLE_KEY = 0x5A11
 
     def _send_extra(self, key, state):
@@ -128,6 +139,8 @@ class PartitioningGossipSimulator(GossipSimulator):
     Every message (and reply) carries a uniformly random partition id; the
     receiver merges only that partition (``PartitionedSGDHandler``).
     """
+
+    _compact_safe = True  # _decode_extra passes the raw partition id through
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -155,6 +168,12 @@ class CacheNeighGossipSimulator(GossipSimulator):
     slot, merge-updates with it, then gossips its refreshed model
     (node.py:446-452). The reference's ``random.choice(set(...))`` crash on
     sets (node.py:449, latent bug) is fixed by construction.
+
+    The parked [N, max_deg] model slots — ~degree x the model term, the
+    variant's dominant state — are stored in the engine's ``history_dtype``
+    wire format (they ARE received wire payloads): bf16/int8 parking cuts
+    the cache the same 2-4x as the history ring, with a per-(node, slot,
+    leaf) scale sidecar for int8. fp32 keeps today's exact behavior.
     """
 
     def __init__(self, *args, **kwargs):
@@ -164,21 +183,33 @@ class CacheNeighGossipSimulator(GossipSimulator):
         # itself, so a SparseTopology CacheNeigh run scales to the node
         # counts the vanilla engine reaches (a dense [N, N] slot_of table,
         # the round-2 design, was the one remaining N^2 object here).
-        nbr = build_neighbor_table(self.topology)
+        # Slot-keyed parking requires duplicate-free rows (one slot per
+        # peer); plain multigraph consumers pass reject_duplicates=False.
+        nbr = build_neighbor_table(self.topology, reject_duplicates=True)
         self.max_deg = nbr.shape[1]
         self.nbr_table = jnp.asarray(nbr)
 
     def _init_aux(self, model: ModelState, key: jax.Array):
         S = self.max_deg
+        wire = {"float32": None, "bfloat16": jnp.bfloat16,
+                "int8": jnp.int8}[self.history_dtype]
         cache_params = jax.tree.map(
-            lambda l: jnp.zeros((l.shape[0], S) + l.shape[1:], l.dtype),
+            lambda l: jnp.zeros((l.shape[0], S) + l.shape[1:],
+                                wire or l.dtype),
             model.params)
-        return {
+        aux = {
             "cache_params": cache_params,
             "cache_age": jnp.zeros((self.n_nodes, S) + model.n_updates.shape[1:],
                                    dtype=model.n_updates.dtype),
             "cache_valid": jnp.zeros((self.n_nodes, S), dtype=bool),
         }
+        if self.history_dtype == "int8":
+            # One f32 dequant scale per (node, slot, leaf); scale 1 on the
+            # zero-initialized (never-read) slots keeps dequant finite.
+            aux["cache_scale"] = jax.tree.map(
+                lambda l: jnp.ones((self.n_nodes, S), jnp.float32),
+                model.params)
+        return aux
 
     def _apply_receive(self, state: SimState, peer: PeerModel, extra, valid,
                        call_key) -> SimState:
@@ -198,9 +229,16 @@ class CacheNeighGossipSimulator(GossipSimulator):
             return jnp.where(ok.reshape((-1,) + (1,) * (cache.ndim - 1)),
                              upd, cache)
 
+        # Re-encode into the wire format before parking (a no-op for fp32;
+        # lossless re-quantization for int8 — the symmetric grid's max maps
+        # back to the same scale).
+        stored, scales = self._encode_history_rows(peer.params)
         aux = dict(state.aux)
         aux["cache_params"] = jax.tree.map(park, state.aux["cache_params"],
-                                           peer.params)
+                                           stored)
+        if self.history_dtype == "int8":
+            aux["cache_scale"] = jax.tree.map(park, state.aux["cache_scale"],
+                                              scales)
         aux["cache_age"] = park(state.aux["cache_age"], peer.n_updates)
         aux["cache_valid"] = state.aux["cache_valid"].at[idx, slot_c].set(
             jnp.where(ok, True, state.aux["cache_valid"][idx, slot_c]))
@@ -225,9 +263,13 @@ class CacheNeighGossipSimulator(GossipSimulator):
             self._round_key(base_key, r, _K_CACHE_POP), logits, axis=-1)
         pick_c = jnp.clip(pick, 0, self.max_deg - 1)
         idx = jnp.arange(self.n_nodes)
-        cached = PeerModel(
-            jax.tree.map(lambda c: c[idx, pick_c], state.aux["cache_params"]),
-            state.aux["cache_age"][idx, pick_c])
+        popped = jax.tree.map(lambda c: c[idx, pick_c],
+                              state.aux["cache_params"])
+        pop_scales = (jax.tree.map(lambda s: s[idx, pick_c],
+                                   state.aux["cache_scale"])
+                      if self.history_dtype == "int8" else ())
+        cached = PeerModel(self._decode_history_rows(popped, pop_scales),
+                           state.aux["cache_age"][idx, pick_c])
         do = fires & any_cached
         keys = jax.random.split(self._round_key(base_key, r, _K_CACHE_MERGE),
                                 self.n_nodes)
@@ -280,7 +322,8 @@ class PENSGossipSimulator(GossipSimulator):
         # outside a node's out-neighbor row are dropped from the counters by
         # construction, which also guarantees phase 2 never selects a
         # non-neighbor (on a directed graph a dense counter could).
-        nbr = build_neighbor_table(self.topology)
+        # Slot-keyed counters require duplicate-free rows.
+        nbr = build_neighbor_table(self.topology, reject_duplicates=True)
         self.max_deg = nbr.shape[1]
         self.nbr_table = jnp.asarray(nbr)
 
@@ -497,7 +540,11 @@ class PENSGossipSimulator(GossipSimulator):
                     return self._round(s, k_run, last)
 
                 return jax.lax.scan(body, state, None, length=r2)
-            self._jit_cache[cache_k] = jax.jit(jax.vmap(cont))
+            # Donate the stacked segment-1 states: the [S, D, N, ...]
+            # history rings are the dominant term and the inputs are dead
+            # after this call (start()'s donation policy, applied here).
+            self._jit_cache[cache_k] = jax.jit(jax.vmap(cont),
+                                               donate_argnums=(0,))
         states, stats2 = self._jit_cache[cache_k](states, keys)
         host2 = jax.tree.map(np.asarray, stats2)
         reports = []

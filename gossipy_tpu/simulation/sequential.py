@@ -137,6 +137,10 @@ class SequentialGossipSimulator(SimulationEventSender):
         self.has_global_eval = "x_eval" in data
         # Per-node out-neighbor lists (host ints; peer sampling is host-side
         # scheduling, like every other random draw in this engine).
+        # reject_duplicates stays False: a multigraph row is harmless here —
+        # like the reference, a duplicate edge just raises that peer's
+        # sampling weight (only SLOT-KEYED variant state needs unique rows;
+        # PENS/CacheNeigh opt into the rejection themselves).
         from .nodes import build_neighbor_table
         nbr = build_neighbor_table(topology)
         self._nbrs = [row[row >= 0] for row in nbr]

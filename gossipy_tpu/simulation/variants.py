@@ -183,6 +183,12 @@ class All2AllGossipSimulator(GossipSimulator):
     node.py:841 with missing cache entries); message delays collapse to
     round granularity (a round's mix uses round-start snapshots).
 
+    ``history_dtype`` (engine knob): under a quantized wire format the PEER
+    contributions to the mix are routed through the wire round-trip
+    (quantize -> dequantize, modelling the broadcast payload) while each
+    node's self term stays exact; the fp32 default keeps today's single
+    fused matmul unchanged.
+
     With ``ring_mix=True`` (requires ``mesh``) the mixing matmul runs as an
     explicit shard_map + ppermute ring schedule over the mesh's node axis
     (:mod:`gossipy_tpu.parallel.collectives`) instead of a dense einsum whose
@@ -321,13 +327,19 @@ class All2AllGossipSimulator(GossipSimulator):
             self_eff = self.mixing.self_w * inv
 
             def mix_tree(params):
-                def leaf(p):
+                # Peer contributions travel the wire: gather the wire-format
+                # round-trip of the senders' params (identity — the same
+                # arrays — for fp32); the self term stays exact.
+                wire = (params if self.history_dtype == "float32"
+                        else self._wire_roundtrip(params))
+
+                def leaf(p, wp):
                     flat = p.reshape(n, -1)
-                    gathered = flat[nbr]  # [N, S, D]
+                    gathered = wp.reshape(n, -1)[nbr]  # [N, S, D]
                     out = self_eff[:, None] * flat + \
                         jnp.einsum("ns,nsd->nd", w_eff, gathered)
                     return out.reshape(p.shape)
-                return jax.tree.map(leaf, params)
+                return jax.tree.map(leaf, params, wire)
 
             n_sent = sent.sum()
             # Cause attribution matches the bulk engine: a dropped message
@@ -362,14 +374,17 @@ class All2AllGossipSimulator(GossipSimulator):
             self_eff = mix.self_w * inv
 
             def mix_tree(params):
-                def leaf(p):
+                wire = (params if self.history_dtype == "float32"
+                        else self._wire_roundtrip(params))
+
+                def leaf(p, wp):
                     flat = p.reshape(n, -1)
-                    contrib = w_e_eff[:, None] * flat[mix.senders]
+                    contrib = w_e_eff[:, None] * wp.reshape(n, -1)[mix.senders]
                     out = self_eff[:, None] * flat + \
                         jax.ops.segment_sum(contrib, mix.rows, n,
                                             indices_are_sorted=True)
                     return out.reshape(p.shape)
-                return jax.tree.map(leaf, params)
+                return jax.tree.map(leaf, params, wire)
 
             n_sent = sent_e.sum()
             n_drop = (sent_e & drop_e).sum()
@@ -407,18 +422,41 @@ class All2AllGossipSimulator(GossipSimulator):
 
             # The mixing merge: one matmul per parameter leaf — dense
             # einsum, or the explicit shard_map+ppermute ring schedule over
-            # the mesh.
+            # the mesh. Under a quantized wire format the matmul splits
+            # into exact-self-diagonal + off-diagonal-over-wire-params (the
+            # fp32 path keeps today's single fused matmul bit-for-bit).
+            if self.history_dtype != "float32":
+                w_diag = jnp.diag(w_eff)
+                w_off = w_eff - jnp.diag(w_diag)
             if self.ring_mix:
                 from ..parallel.collectives import ring_mix_pytree
 
-                def mix_tree(params):
-                    return ring_mix_pytree(w_eff, params, self.mesh,
-                                           self._ring_axis)
-            else:
+                if self.history_dtype == "float32":
+                    def mix_tree(params):
+                        return ring_mix_pytree(w_eff, params, self.mesh,
+                                               self._ring_axis)
+                else:
+                    def mix_tree(params):
+                        wire = self._wire_roundtrip(params)
+                        mixed = ring_mix_pytree(w_off, wire, self.mesh,
+                                                self._ring_axis)
+                        return jax.tree.map(
+                            lambda p, m: (w_diag[:, None] * p.reshape(n, -1)
+                                          + m.reshape(n, -1)).reshape(p.shape),
+                            params, mixed)
+            elif self.history_dtype == "float32":
                 def mix_tree(params):
                     return jax.tree.map(
                         lambda p: (w_eff @ p.reshape(n, -1)).reshape(p.shape),
                         params)
+            else:
+                def mix_tree(params):
+                    wire = self._wire_roundtrip(params)
+                    return jax.tree.map(
+                        lambda p, wp: (w_diag[:, None] * p.reshape(n, -1)
+                                       + w_off @ wp.reshape(n, -1)
+                                       ).reshape(p.shape),
+                        params, wire)
 
         size = self._model_size(state.model.params)
         mode = self.handler.mode

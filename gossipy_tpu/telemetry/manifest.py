@@ -95,6 +95,7 @@ def _config_snapshot(sim: Any) -> dict:
                       ("K", "mailbox_slots"), ("Kr", "reply_slots"),
                       ("F", "max_fires_per_round"),
                       ("fused_merge", "fused_merge"),
+                      ("history_dtype", "history_dtype"),
                       ("_compact_cap", "compact_cap")):
         if hasattr(sim, attr):
             snap[key] = getattr(sim, attr)
@@ -130,6 +131,7 @@ class RunManifest:
     memory_budget: Optional[dict] = None
     mesh: Optional[dict] = None
     compile_seconds: Optional[float] = None
+    compilation_cache: Optional[dict] = None
     created_at: float = field(default_factory=time.time)
     extra: dict = field(default_factory=dict)
     schema: int = MANIFEST_SCHEMA
@@ -153,6 +155,11 @@ class RunManifest:
                 budget = None
         if compile_seconds is None:
             compile_seconds = getattr(sim, "last_compile_seconds", None)
+        try:
+            from .. import compilation_cache_stats
+            cache_stats = compilation_cache_stats()
+        except Exception:
+            cache_stats = None
         return cls(
             config=_config_snapshot(sim),
             backend=_backend_info(),
@@ -161,6 +168,7 @@ class RunManifest:
             memory_budget=budget,
             mesh=_mesh_info(sim),
             compile_seconds=compile_seconds,
+            compilation_cache=cache_stats,
             extra=dict(extra or {}),
         )
 
@@ -175,6 +183,7 @@ class RunManifest:
             "memory_budget": self.memory_budget,
             "mesh": self.mesh,
             "compile_seconds": self.compile_seconds,
+            "compilation_cache": self.compilation_cache,
         }
         if self.extra:
             out["extra"] = self.extra

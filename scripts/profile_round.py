@@ -98,7 +98,8 @@ def time_config(rounds: int, **kwargs) -> float:
     sim = build_sim(**kwargs)
     key = jax.random.PRNGKey(42)
     state = sim.init_nodes(key)
-    s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile + warm
+    s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile + warm
+                      donate_state=False)
     jax.block_until_ready(s2.model.params)
     t0 = time.perf_counter()
     s3, _ = sim.start(state, n_rounds=rounds, key=key)
@@ -193,7 +194,8 @@ def main() -> None:
     if args.trace:
         sim = build_sim(args.cnn, n_nodes, sampling_eval=sampling)
         state = sim.init_nodes(key)
-        s2, _ = sim.start(state, n_rounds=rounds, key=key)  # compile first
+        s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile first
+                          donate_state=False)
         jax.block_until_ready(s2.model.params)
         with jax.profiler.trace(args.trace):
             s3, _ = sim.start(state, n_rounds=rounds, key=key)

@@ -16,7 +16,7 @@ from gossipy_tpu.models import AdaLine
 from gossipy_tpu.simulation import GossipSimulator
 
 
-def make_sim(n_nodes=8, seed=0):
+def make_sim(n_nodes=8, seed=0, **kw):
     rng = np.random.default_rng(seed)
     w = rng.normal(size=6)
     X = rng.normal(size=(160, 6)).astype(np.float32)
@@ -26,7 +26,7 @@ def make_sim(n_nodes=8, seed=0):
     handler = PegasosHandler(AdaLine(6), learning_rate=0.01,
                              create_model_mode=CreateModelMode.UPDATE)
     return GossipSimulator(handler, Topology.clique(n_nodes), disp.stacked(),
-                           delta=10, protocol=AntiEntropyProtocol.PUSH)
+                           delta=10, protocol=AntiEntropyProtocol.PUSH, **kw)
 
 
 def states_equal(a, b):
@@ -57,7 +57,7 @@ class TestSaveRestore:
         """
         sim = make_sim()
         st0 = sim.init_nodes(key)
-        full, _ = sim.start(st0, n_rounds=7, key=key)
+        full, _ = sim.start(st0, n_rounds=7, key=key, donate_state=False)
 
         part, _ = sim.start(st0, n_rounds=3, key=key)
         path = save_checkpoint(str(tmp_path / "ckpt"), part, key=key)
@@ -119,3 +119,25 @@ class TestRestoreWithoutTemplateKey:
         restored, rkey = restore_checkpoint(path, sim.init_nodes(jax.random.PRNGKey(3)))
         assert states_equal(st, restored)
         assert rkey is None
+
+    @pytest.mark.parametrize("history_dtype", ["bfloat16", "int8"])
+    def test_quantized_ring_roundtrips(self, tmp_path, key, history_dtype):
+        """A wire-format history ring (and its int8 scale sidecar)
+        checkpoints at its reduced dtype and restores bit-exactly into a
+        same-config template; the resumed run equals the unbroken one."""
+        import jax.numpy as jnp
+
+        sim = make_sim(history_dtype=history_dtype)
+        st0 = sim.init_nodes(key)
+        full, _ = sim.start(st0, n_rounds=5, key=key, donate_state=False)
+
+        part, _ = sim.start(st0, n_rounds=2, key=key)
+        ring_leaf = jax.tree_util.tree_leaves(part.history_params)[0]
+        assert ring_leaf.dtype == (jnp.bfloat16 if history_dtype == "bfloat16"
+                                   else jnp.int8)
+        path = save_checkpoint(str(tmp_path / "ckpt"), part, key=key)
+        template = sim.init_nodes(jax.random.PRNGKey(7))
+        restored, rkey = restore_checkpoint(path, template, key)
+        assert states_equal(part, restored)
+        resumed, _ = sim.start(restored, n_rounds=3, key=rkey)
+        assert states_equal(full.model, resumed.model)

@@ -1,0 +1,364 @@
+"""Quantized params-history ring (GossipSimulator(history_dtype=...)).
+
+The ring is the engine's dominant persistent state term and the deliver
+phase's HBM traffic; ``history_dtype`` stores snapshots in a reduced wire
+format (bf16 cast / int8 + symmetric per-(round-slot, node, leaf) scales)
+and dequantizes on gather, so merge math stays fp32. Contracts pinned here:
+
+- ``"float32"`` (the default) is bit-identical to the pre-feature engine
+  (encode/decode are the identity — the golden/parity suites double as the
+  regression net);
+- bf16/int8 runs track the fp32 accuracy curve within a small band on the
+  100-node bench-shaped config;
+- the pallas dequantizing kernel (interpreter mode on CPU) agrees with the
+  jnp reference for both wire formats;
+- ``memory_budget()`` prices the ring at its wire itemsize and includes the
+  int8 sidecar.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+    SparseTopology, Topology, UniformDelay, uniform_mixing
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, WeightedSGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.ops import gather_merge_flat
+from gossipy_tpu.ops.merge import gather_merge_reference
+from gossipy_tpu.simulation import All2AllGossipSimulator, \
+    CacheNeighGossipSimulator, GossipSimulator
+
+DTYPES = ("float32", "bfloat16", "int8")
+
+
+def make_dataset(n=480, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    return X, y
+
+
+def make_sim(history_dtype, n_nodes=16, d=12, seed=0, sim_cls=GossipSimulator,
+             handler_cls=SGDHandler, topology=None, **kw):
+    X, y = make_dataset(n=30 * n_nodes, d=d, seed=seed)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n_nodes)
+    handler = handler_cls(model=LogisticRegression(d, 2),
+                          loss=losses.cross_entropy,
+                          optimizer=optax.sgd(0.5), local_epochs=1,
+                          batch_size=8, n_classes=2, input_shape=(d,),
+                          create_model_mode=CreateModelMode.MERGE_UPDATE)
+    if topology is None:
+        topology = Topology.clique(n_nodes)
+    return sim_cls(handler, topology, disp.stacked(), delta=10,
+                   history_dtype=history_dtype, **kw)
+
+
+def final_acc(sim, key, rounds=8):
+    st = sim.init_nodes(key)
+    st, rep = sim.start(st, n_rounds=rounds, key=key)
+    return float(rep.curves(local=False)["accuracy"][-1]), st
+
+
+class TestEncodeDecode:
+    def test_int8_roundtrip_error_bound(self, key):
+        sim = make_sim("int8")
+        params = {"w": jax.random.normal(key, (16, 7, 3)) * 5.0,
+                  "b": jax.random.normal(jax.random.fold_in(key, 1), (16, 3))}
+        stored, scales = sim._encode_history_rows(params)
+        assert stored["w"].dtype == jnp.int8
+        assert scales["w"].shape == (16,)
+        out = sim._decode_history_rows(stored, scales)
+        for k in params:
+            x = np.asarray(params[k])
+            err = np.abs(np.asarray(out[k]) - x)
+            # Symmetric grid: |err| <= scale/2 per row = amax/254.
+            amax = np.abs(x).reshape(16, -1).max(axis=1)
+            bound = amax / 254.0 + 1e-7
+            assert (err.reshape(16, -1) <= bound[:, None] + 1e-6).all()
+
+    def test_int8_zero_rows_are_safe(self):
+        sim = make_sim("int8")
+        params = {"w": jnp.zeros((4, 5))}
+        stored, scales = sim._encode_history_rows(params)
+        out = sim._decode_history_rows(stored, scales)
+        assert np.isfinite(np.asarray(out["w"])).all()
+        np.testing.assert_array_equal(np.asarray(out["w"]), 0.0)
+
+    def test_int8_requantize_is_lossless(self, key):
+        # CacheNeigh re-encodes already-dequantized payloads when parking;
+        # the symmetric grid maps its own outputs back to themselves.
+        sim = make_sim("int8")
+        params = {"w": jax.random.normal(key, (8, 11))}
+        stored1, scales1 = sim._encode_history_rows(params)
+        once = sim._decode_history_rows(stored1, scales1)
+        stored2, scales2 = sim._encode_history_rows(once)
+        twice = sim._decode_history_rows(stored2, scales2)
+        np.testing.assert_allclose(np.asarray(once["w"]),
+                                   np.asarray(twice["w"]), atol=1e-6)
+
+    def test_float32_is_identity(self, key):
+        sim = make_sim("float32")
+        params = {"w": jax.random.normal(key, (4, 3))}
+        stored, scales = sim._encode_history_rows(params)
+        assert stored["w"] is params["w"]
+        assert scales == ()
+
+    def test_unknown_dtype_rejected(self):
+        with pytest.raises(ValueError, match="history_dtype"):
+            make_sim("fp8")
+
+
+class TestBitExactDefault:
+    def test_explicit_float32_matches_default(self, key):
+        """history_dtype='float32' must reproduce the default-constructed
+        engine bit for bit (same PRNG streams, identity encode/decode)."""
+        sim_a = make_sim("float32")
+        X, y = make_dataset(n=30 * 16, d=12, seed=0)
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=16)
+        handler = SGDHandler(model=LogisticRegression(12, 2),
+                             loss=losses.cross_entropy,
+                             optimizer=optax.sgd(0.5), local_epochs=1,
+                             batch_size=8, n_classes=2, input_shape=(12,),
+                             create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim_b = GossipSimulator(handler, Topology.clique(16), disp.stacked(),
+                                delta=10)
+        assert sim_b.history_dtype == "float32"
+        _, sa = final_acc(sim_a, key)
+        _, sb = final_acc(sim_b, key)
+        for la, lb in zip(jax.tree_util.tree_leaves(sa.model.params),
+                          jax.tree_util.tree_leaves(sb.model.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestAccuracyParity:
+    def test_100node_quantized_tracks_fp32(self, key):
+        """bf16/int8 rings on the 100-node bench-shaped config (spambase
+        dimensionality, 20-regular graph) stay within a small band of the
+        fp32 accuracy curve — the acceptance contract's CPU-sized stand-in
+        (bench.py --history-dtype measures the full config)."""
+        accs = {}
+        topo = Topology.random_regular(100, 20, seed=42)
+        for hd in DTYPES:
+            sim = make_sim(hd, n_nodes=100, d=57, seed=4, topology=topo)
+            accs[hd], _ = final_acc(sim, key, rounds=10)
+        assert accs["float32"] > 0.8, accs
+        assert abs(accs["bfloat16"] - accs["float32"]) < 0.01, accs
+        assert abs(accs["int8"] - accs["float32"]) < 0.01, accs
+
+    def test_delays_and_replies_with_int8(self, key):
+        sim = make_sim("int8", protocol=AntiEntropyProtocol.PUSH_PULL,
+                       delay=UniformDelay(0, 15))
+        acc, _ = final_acc(sim, key, rounds=8)
+        assert acc > 0.8
+
+    def test_compact_deliver_equivalent_under_int8(self, key):
+        """The compacted slot pass gathers dequantized rows; on/off must
+        not change an int8 trajectory (same contract as fp32 compaction)."""
+        topo = Topology.random_regular(16, 6, seed=7)
+        sim_off = make_sim("int8", topology=topo, compact_deliver=False)
+        sim_on = make_sim("int8", topology=topo, compact_deliver=4)
+        _, s_off = final_acc(sim_off, key, rounds=6)
+        _, s_on = final_acc(sim_on, key, rounds=6)
+        for a, b in zip(jax.tree_util.tree_leaves(s_off.model.params),
+                        jax.tree_util.tree_leaves(s_on.model.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0, atol=1e-6)
+
+
+class TestDequantKernel:
+    @pytest.mark.parametrize("n,m,f", [(16, 48, 116), (8, 8, 512), (5, 10, 1)])
+    def test_bf16_matches_reference(self, n, m, f):
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+        h = jnp.asarray(rng.normal(size=(m, f)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+        w1 = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+        got = gather_merge_flat(p, h, idx, w1, 1.0 - w1)
+        want = gather_merge_reference(p, h, idx, w1, 1.0 - w1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("n,m,f", [(16, 48, 116), (8, 8, 512), (5, 10, 1)])
+    def test_int8_matches_reference(self, n, m, f):
+        rng = np.random.default_rng(1)
+        p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+        h = jnp.asarray(rng.integers(-127, 128, (m, f)).astype(np.int8))
+        scale = jnp.asarray(rng.uniform(0.01, 2.0, m).astype(np.float32))
+        idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+        w1 = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+        got = gather_merge_flat(p, h, idx, w1, 1.0 - w1, scale=scale)
+        want = gather_merge_reference(p, h, idx, w1, 1.0 - w1, scale)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("history_dtype", ["bfloat16", "int8"])
+    def test_fused_engine_path_matches_unfused(self, key, history_dtype):
+        """fused_merge over a quantized ring (kernel dequant) == the
+        gather->decode->blend path (same PRNG streams, fp reassociation
+        only)."""
+        sim_a = make_sim(history_dtype, n_nodes=12, fused_merge=False,
+                         compact_deliver=False)
+        sim_b = make_sim(history_dtype, n_nodes=12, fused_merge=True)
+        _, sa = final_acc(sim_a, key, rounds=6)
+        _, sb = final_acc(sim_b, key, rounds=6)
+        for la, lb in zip(jax.tree_util.tree_leaves(sa.model.params),
+                          jax.tree_util.tree_leaves(sb.model.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestMemoryBudget:
+    def test_ring_bytes_scale_with_format(self):
+        budgets = {hd: make_sim(hd, n_nodes=100, d=57).memory_budget()
+                   for hd in DTYPES}
+        f32 = budgets["float32"]["history_ring_bytes"]
+        bf16 = budgets["bfloat16"]["history_ring_bytes"]
+        i8 = budgets["int8"]["history_ring_bytes"]
+        # Acceptance bands: >= 2x under bf16, >= 3.5x under int8 (sidecar
+        # INCLUDED in the int8 ring term).
+        assert f32 / bf16 >= 2.0, (f32, bf16)
+        assert f32 / i8 >= 3.5, (f32, i8)
+        assert budgets["int8"]["history_ring_sidecar"] > 0
+        assert budgets["float32"]["history_ring_sidecar"] == 0
+        assert budgets["int8"]["history_dtype"] == "int8"
+        # Depth must not depend on the storage format.
+        assert len({b["history_depth"] for b in budgets.values()}) == 1
+
+    def test_wire_bytes_per_message(self):
+        sims = {hd: make_sim(hd, d=57) for hd in DTYPES}
+        # LogReg(57, 2): 116 scalars over 2 leaves.
+        assert sims["float32"].wire_bytes_per_message() == 116 * 4
+        assert sims["bfloat16"].wire_bytes_per_message() == 116 * 2
+        assert sims["int8"].wire_bytes_per_message() == 116 + 2 * 4
+
+    def test_manifest_records_history_dtype(self):
+        sim = make_sim("int8")
+        manifest = sim.run_manifest()
+        assert manifest.config["history_dtype"] == "int8"
+        assert manifest.to_dict()["config"]["history_dtype"] == "int8"
+
+
+class TestVariantsWireFormat:
+    @pytest.mark.parametrize("history_dtype", ["bfloat16", "int8"])
+    def test_all2all_learns_under_quantized_wire(self, key, history_dtype):
+        topo = Topology.clique(16)
+        sim = make_sim(history_dtype, sim_cls=All2AllGossipSimulator,
+                       handler_cls=WeightedSGDHandler, topology=topo,
+                       mixing=uniform_mixing(topo))
+        acc, _ = final_acc(sim, key, rounds=8)
+        sim_f = make_sim("float32", sim_cls=All2AllGossipSimulator,
+                         handler_cls=WeightedSGDHandler, topology=topo,
+                         mixing=uniform_mixing(topo))
+        acc_f, _ = final_acc(sim_f, key, rounds=8)
+        assert abs(acc - acc_f) < 0.05, (acc, acc_f)
+        assert acc > 0.8
+
+    def test_cacheneigh_parks_in_wire_format(self, key):
+        sim = make_sim("int8", n_nodes=12, sim_cls=CacheNeighGossipSimulator,
+                       topology=Topology.random_regular(12, 4, seed=3))
+        st = sim.init_nodes(key)
+        leaves = jax.tree_util.tree_leaves(st.aux["cache_params"])
+        assert all(l.dtype == jnp.int8 for l in leaves)
+        assert "cache_scale" in st.aux
+        st, rep = sim.start(st, n_rounds=8, key=key)
+        assert rep.curves(local=False)["accuracy"][-1] > 0.75
+
+    def test_cacheneigh_fp32_aux_unchanged(self, key):
+        sim = make_sim("float32", n_nodes=12,
+                       sim_cls=CacheNeighGossipSimulator,
+                       topology=Topology.random_regular(12, 4, seed=3))
+        st = sim.init_nodes(key)
+        assert "cache_scale" not in st.aux
+        assert all(l.dtype == jnp.float32 for l in
+                   jax.tree_util.tree_leaves(st.aux["cache_params"]))
+
+
+class TestNeighborTableDuplicates:
+    def _dup_topology(self):
+        # The 0-1 edge listed twice: a multigraph (each node's CSR row
+        # repeats its peer; reference semantics = doubled sampling weight).
+        return SparseTopology(2, np.array([[0, 1], [0, 1]]))
+
+    def test_default_accepts_multigraph(self):
+        from gossipy_tpu.simulation.nodes import build_neighbor_table
+        nbr = build_neighbor_table(self._dup_topology())
+        assert (nbr[0] == [1, 1]).all()
+
+    def test_opt_in_rejects_duplicates(self):
+        from gossipy_tpu.simulation.nodes import build_neighbor_table
+        with pytest.raises(ValueError, match="more than once"):
+            build_neighbor_table(self._dup_topology(), reject_duplicates=True)
+
+    def test_cacheneigh_still_rejects(self, key):
+        with pytest.raises(ValueError, match="more than once"):
+            make_sim("float32", n_nodes=2, sim_cls=CacheNeighGossipSimulator,
+                     topology=self._dup_topology())
+
+
+class TestCompactSafeAttribute:
+    def _subclassed_sim(self, cls, **kw):
+        return make_sim("float32", n_nodes=64, sim_cls=cls,
+                        topology=Topology.random_regular(64, 6, seed=1), **kw)
+
+    def test_unsafe_decode_extra_override_disables_auto(self):
+        class Unsafe(GossipSimulator):
+            def _decode_extra(self, extra):
+                return extra
+
+        sim = self._subclassed_sim(Unsafe)
+        assert sim._compact_cap is None  # auto stayed off
+
+    def test_unsafe_override_rejects_explicit_compaction(self):
+        class Unsafe(GossipSimulator):
+            def _decode_extra(self, extra):
+                return extra
+
+        with pytest.raises(AssertionError, match="_compact_safe"):
+            self._subclassed_sim(Unsafe, compact_deliver=4)
+
+    def test_declared_safe_override_auto_enables(self):
+        class Safe(GossipSimulator):
+            _compact_safe = True
+
+            def _decode_extra(self, extra):
+                return extra
+
+        sim = self._subclassed_sim(Safe)
+        assert sim._compact_cap is not None
+
+
+class TestDonation:
+    def test_donated_state_is_invalidated(self, key):
+        sim = make_sim("float32", n_nodes=8)
+        st = sim.init_nodes(key)
+        st2, _ = sim.start(st, n_rounds=2, key=key)  # donates st
+        assert np.isfinite(np.asarray(
+            jax.tree_util.tree_leaves(st2.model.params)[0])).all()
+        with pytest.raises(RuntimeError):
+            np.asarray(jax.tree_util.tree_leaves(st.model.params)[0])
+
+    def test_donate_false_keeps_input_alive(self, key):
+        sim = make_sim("float32", n_nodes=8)
+        st = sim.init_nodes(key)
+        _, r1 = sim.start(st, n_rounds=2, key=key, donate_state=False)
+        _, r2 = sim.start(st, n_rounds=2, key=key, donate_state=False)
+        np.testing.assert_allclose(r1.curves(local=False)["accuracy"],
+                                   r2.curves(local=False)["accuracy"])
+
+
+class TestCompilationCacheStats:
+    def test_stats_shape_and_manifest_field(self):
+        from gossipy_tpu import compilation_cache_stats
+        stats = compilation_cache_stats()
+        assert set(stats) == {"enabled", "dir", "events"}
+        sim = make_sim("float32")
+        d = sim.run_manifest().to_dict()
+        assert "compilation_cache" in d

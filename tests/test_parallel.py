@@ -238,7 +238,8 @@ def test_load_into_tp_mesh(tmp_path, key):
     st = sim.init_nodes(key)
     st, _ = sim.start(st, n_rounds=2, key=key)
     path = sim.save(str(tmp_path / "ck"), st, key=key)
-    _, rep_plain = sim.start(st, n_rounds=2, key=jax.random.fold_in(key, 9))
+    _, rep_plain = sim.start(st, n_rounds=2, key=jax.random.fold_in(key, 9),
+                             donate_state=False)
 
     mesh = make_mesh_tp(4, 2)
     sim_sh, _ = build(data=shard_data(disp.stacked(), mesh))

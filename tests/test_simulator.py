@@ -70,7 +70,8 @@ class TestMinimumSlice:
     def test_deterministic_given_key(self, key):
         sim = make_sim()
         st0 = sim.init_nodes(key)
-        _, r1 = sim.start(st0, n_rounds=4, key=jax.random.fold_in(key, 9))
+        _, r1 = sim.start(st0, n_rounds=4, key=jax.random.fold_in(key, 9),
+                          donate_state=False)
         _, r2 = sim.start(st0, n_rounds=4, key=jax.random.fold_in(key, 9))
         np.testing.assert_allclose(
             r1.curves(local=False)["accuracy"], r2.curves(local=False)["accuracy"])
@@ -172,7 +173,8 @@ class TestMinimumSlice:
         st = sim.init_nodes(key)
         # Periods 10 and 5: 2 and 4 multiples per 20-tick round.
         st = st._replace(phase=jnp.full((8,), 10, dtype=jnp.int32))
-        _, rep2 = sim.start(st, n_rounds=4, key=jax.random.fold_in(key, 1))
+        _, rep2 = sim.start(st, n_rounds=4, key=jax.random.fold_in(key, 1),
+                            donate_state=False)
         assert rep2.sent_messages == 4 * 8 * 2, rep2.sent_messages
         st = st._replace(phase=jnp.full((8,), 5, dtype=jnp.int32))
         _, rep4 = sim.start(st, n_rounds=4, key=jax.random.fold_in(key, 1))

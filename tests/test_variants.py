@@ -194,7 +194,7 @@ class TestAll2All:
         sim = All2AllGossipSimulator(handler, topo, data, delta=10,
                                      mixing=uniform_mixing(topo))
         st0 = sim.init_nodes(key)
-        st, _ = sim.start(st0, n_rounds=6)
+        st, _ = sim.start(st0, n_rounds=6, donate_state=False)
 
         def spread(model):
             k = model.params["Dense_0"]["kernel"]
@@ -388,7 +388,7 @@ class TestReactiveTokenConservation:
         aux = dict(st.aux)
         aux["balance"] = jnp.full((16,), 30, dtype=jnp.int32)
         st = st._replace(aux=aux)
-        st2, rep = sim.start(st, n_rounds=1, key=key)
+        st2, rep = sim.start(st, n_rounds=1, key=key, donate_state=False)
         spent = np.asarray(st.aux["balance"]) - np.asarray(st2.aux["balance"])
         # Balance may also GROW by 1 for gated proactive sends; reactions can
         # never debit more than the cap.
