@@ -58,6 +58,9 @@ HISTORY_DTYPE = "float32"
 # Wire-traffic stamp filled by the measured run (bytes moved per round under
 # the configured format), merged into the emitted JSON's raw block.
 WIRE_INFO: dict = {}
+# Probes-on vs probes-off throughput stamp (north-star mode): the overhead
+# of the opt-in gossip-dynamics probes, itself observed. Merged into raw.
+PROBE_INFO: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -135,7 +138,7 @@ def make_data():
     return X, y
 
 
-def build_sim(X, y, fused: bool = False):
+def build_sim(X, y, fused: bool = False, probes: bool = False):
     """The bench configuration (shared by the throughput and to-accuracy
     modes): 100 nodes, LogReg SGD, MERGE_UPDATE, PUSH over a 20-regular
     graph, per-round global eval."""
@@ -160,15 +163,17 @@ def build_sim(X, y, fused: bool = False):
                            disp.stacked(), delta=ROUND_LEN,
                            protocol=AntiEntropyProtocol.PUSH,
                            fused_merge=fused,
-                           history_dtype=HISTORY_DTYPE)
+                           history_dtype=HISTORY_DTYPE,
+                           probes=probes)
 
 
 def bench_ours(X, y) -> float:
     import jax
 
-    def run(fused: bool) -> tuple[float, float, object, object]:
+    def run(fused: bool, probes: bool = False) \
+            -> tuple[float, float, object, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
-        sim = build_sim(X, y, fused)
+        sim = build_sim(X, y, fused, probes=probes)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
         # Warmup: trigger compilation of the scan (donate_state=False: the
@@ -199,6 +204,26 @@ def bench_ours(X, y) -> float:
     print(f"[bench] ours ({label}): {n_rounds} rounds in {elapsed:.2f}s "
           f"({n_rounds/elapsed:.1f} r/s), final global acc {acc:.3f}",
           file=sys.stderr)
+    try:
+        # Observability overhead, itself observed: the same plain config
+        # with the gossip-dynamics probes on (consensus + staleness +
+        # mixing), A/B'd against the probes-off measurement above. The
+        # probes-off run IS the default path (probes=None compiles the
+        # identical program), so its delta is structurally zero; the
+        # probes-on fraction is the stamped cost of watching the dynamics.
+        elapsed_p, _, _, _ = run(False, probes=True)
+        PROBE_INFO.update({
+            "probes_off_rounds_per_sec": round(n_rounds / elapsed, 2),
+            "probes_on_rounds_per_sec": round(n_rounds / elapsed_p, 2),
+            "probes_overhead_frac": round(
+                max(0.0, 1.0 - elapsed / elapsed_p), 4),
+        })
+        print(f"[bench] probes on: {n_rounds} rounds in {elapsed_p:.2f}s "
+              f"({n_rounds / elapsed_p:.1f} r/s; overhead "
+              f"{PROBE_INFO['probes_overhead_frac']:.1%} vs probes off)",
+              file=sys.stderr)
+    except Exception as e:  # the A/B must not kill the main measurement
+        print(f"[bench] probes A/B failed ({e!r})", file=sys.stderr)
     stamp_wire_traffic(sim, report, n_rounds)
     emit_manifest(sim, f"north-star/{label}")
     return n_rounds / elapsed
@@ -1357,6 +1382,7 @@ def main():
         "vs_baseline": round(ours / baseline, 2),
         "raw": {
             **WIRE_INFO,
+            **PROBE_INFO,
             "ours_rounds_per_sec": round(ours, 2),
             "ours_rounds_measured": (BENCH_ROUNDS_DEGRADED if DEGRADED
                                      else BENCH_ROUNDS),

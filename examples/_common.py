@@ -48,6 +48,16 @@ def add_repetitions_flag(p):
     return p
 
 
+def add_probes_flag(p):
+    """Only for scripts that pass it through to their simulator."""
+    p.add_argument("--probes", action="store_true",
+                   help="compute the in-graph gossip-dynamics probes "
+                        "(consensus distance, merge staleness, realized "
+                        "mixing — docs/observability.md) and print their "
+                        "summary")
+    return p
+
+
 def finish(report, args, local: bool = False, label: str = "final"):
     """Print a one-line JSON summary + optionally save the plot.
 
@@ -69,6 +79,27 @@ def finish(report, args, local: bool = False, label: str = "final"):
         finals = [e[-1][1] for e in evals_per_rep if e]
         summary[label] = {k: round(sum(f[k] for f in finals) / len(finals), 4)
                           for k in finals[0]}
+    cm = getattr(reports[0], "probe_consensus_mean", None)
+    if cm is not None and len(cm):
+        # Gossip-dynamics probe summary (runs started with probes=).
+        probes = {"consensus_first": round(float(cm[0]), 6),
+                  "consensus_last": round(float(cm[-1]), 6)}
+        sm = getattr(reports[0], "probe_stale_max", None)
+        if sm is not None and len(sm):
+            import numpy as _np
+            probes["stale_max"] = int(_np.max(sm))
+        acc = getattr(reports[0], "probe_accepted_per_node", None)
+        if acc is not None:
+            import numpy as _np
+            probes["accepted_total"] = int(_np.sum(acc))
+        md = getattr(reports[0], "probe_merge_delta", None)
+        td = getattr(reports[0], "probe_train_delta", None)
+        if md is not None and len(md):
+            import numpy as _np
+            if _np.isfinite(md[-1]):
+                probes["merge_delta_last"] = round(float(md[-1]), 6)
+                probes["train_delta_last"] = round(float(td[-1]), 6)
+        summary["probes"] = probes
     print(json.dumps(summary))
     if args.plot:
         from gossipy_tpu.utils import plot_evaluation

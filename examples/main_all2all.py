@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import optax
 
-from _common import make_parser, finish
+from _common import add_probes_flag, make_parser, finish
 
 from gossipy_tpu import set_seed
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology, \
@@ -28,6 +28,7 @@ def main():
     parser = make_parser(__doc__, rounds=100, nodes=100)
     parser.add_argument("--mixing", choices=["uniform", "metropolis"],
                         default="uniform")
+    add_probes_flag(parser)
     args = parser.parse_args()
     key = set_seed(args.seed)
 
@@ -50,7 +51,7 @@ def main():
         handler, topology, dispatcher.stacked(),
         mixing=mix(topology),
         delta=100, protocol=AntiEntropyProtocol.PUSH,
-        sampling_eval=0.1, sync=False)
+        sampling_eval=0.1, sync=False, probes=args.probes)
 
     state = simulator.init_nodes(key)
     state, report = simulator.start(state, n_rounds=args.rounds, key=key)

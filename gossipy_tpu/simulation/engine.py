@@ -41,13 +41,14 @@ Fidelity notes (documented divergences, SURVEY.md §7c):
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import AntiEntropyProtocol, ConstantDelay, Delay, MessageType, Topology
+from ..core import AntiEntropyProtocol, ConstantDelay, CreateModelMode, \
+    Delay, MessageType, Topology
 from ..handlers.base import BaseHandler, ModelState, PeerModel
 from ..telemetry import (
     PHASE_EVAL,
@@ -56,7 +57,17 @@ from ..telemetry import (
     PHASE_SEND,
     PHASE_TRAIN,
     FailureCounts,
+    ProbeAccum,
+    ProbeConfig,
     emit_event,
+)
+from ..telemetry.probes import (
+    PROBE_STAT_KEYS,
+    consensus_stats,
+    param_layer_names,
+    probe_event_row,
+    probe_stats_from_accum,
+    sq_param_distance,
 )
 from .events import SimulationEventSender
 from .report import SimulationReport
@@ -333,6 +344,23 @@ class GossipSimulator(SimulationEventSender):
         max population / ring depth on a fixed chip; they also model real
         gossip wire compression. Merge math always runs in fp32 — only the
         stored snapshot is low-precision.
+    probes : ProbeConfig | bool | None
+        Opt-in gossip-dynamics probes computed INSIDE the jitted round
+        program (:mod:`gossipy_tpu.telemetry.probes`): consensus distance
+        (mean/max L2 from the population-mean params + per-layer
+        breakdown), merge-staleness distribution (mean/max + clamped
+        histogram of ``round − send_round`` over accepted messages), and
+        realized mixing (per-node accepted-merge counts, merge-delta vs
+        train-delta norms). ``None`` (default) traces the exact same
+        program as before the feature; ``True`` enables all probes; a
+        :class:`~gossipy_tpu.telemetry.ProbeConfig` picks a subset. Probe
+        arrays land in the :class:`SimulationReport` (``probe_*``), stream
+        through the ``update_probes`` observer event (live path included)
+        and are stamped into the run manifest. The merge/train delta
+        decomposition is exact only for the base receive pipeline under
+        MERGE_UPDATE (recomputing the handler's merge as a pure probe);
+        variants with custom receive behavior report NaN deltas while the
+        other probes stay live.
     """
 
     # Out-of-tree subclasses that override ``_decode_extra`` or
@@ -362,7 +390,8 @@ class GossipSimulator(SimulationEventSender):
                  fused_merge: bool = False,
                  compact_deliver: Optional[bool] = None,
                  max_fires_per_round: Optional[int] = None,
-                 history_dtype: str = "float32"):
+                 history_dtype: str = "float32",
+                 probes: Union[None, bool, ProbeConfig] = None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -413,7 +442,6 @@ class GossipSimulator(SimulationEventSender):
                     f"overridden by {type(self).__name__})"
             assert getattr(handler, "uniform_avg_merge", False), \
                 "fused_merge requires a uniform-average merge handler"
-            from ..core import CreateModelMode
             assert handler.mode == CreateModelMode.MERGE_UPDATE, \
                 "fused_merge only fuses the MERGE_UPDATE path"
 
@@ -481,6 +509,21 @@ class GossipSimulator(SimulationEventSender):
             self._compact_cap = (
                 self._derive_compact_cap() if compact_deliver
                 else None)
+
+        # Gossip-dynamics probes: None = strictly no probe code in the
+        # trace (the default round program is byte-identical to the
+        # pre-feature one). The merge/train-delta decomposition recomputes
+        # the handler's merge as a pure probe, which is only exact when the
+        # receive pipeline is the base MERGE_UPDATE one — custom receive
+        # variants (PassThrough's accept draw, CacheNeigh's parking, PENS
+        # phase 1) report NaN deltas instead of a wrong number.
+        self.probes: Optional[ProbeConfig] = ProbeConfig.coerce(probes)
+        self._probe_delta_ok = (
+            self.probes is not None and self.probes.mixing
+            and self.handler.mode == CreateModelMode.MERGE_UPDATE
+            and all(getattr(type(self), hook)
+                    is getattr(GossipSimulator, hook)
+                    for hook in ("_apply_receive", "_receive_rows")))
 
     # -- setup -------------------------------------------------------------
 
@@ -1170,6 +1213,83 @@ class GossipSimulator(SimulationEventSender):
         return (took_compact.astype(jnp.int32),
                 (occupied_slot & ~took_compact).astype(jnp.int32))
 
+    # -- probes (opt-in; see telemetry.probes) ------------------------------
+
+    def _probe_slots_on(self) -> bool:
+        """Static: whether the deliver/reply slot loops carry a probe
+        accumulator (staleness or mixing probes enabled)."""
+        return self.probes is not None and (self.probes.staleness
+                                            or self.probes.mixing)
+
+    def _probe_zero_accum(self) -> ProbeAccum:
+        return ProbeAccum.zeros(self.n_nodes,
+                                self.probes.staleness_buckets)
+
+    def _probe_slot_update(self, pa: ProbeAccum, state: SimState,
+                           pre_model: ModelState, send_round, sender, extra,
+                           apply_mask, r) -> ProbeAccum:
+        """Fold one slot's accepted merges into the probe accumulator:
+        staleness/counts always; the merge-vs-train delta decomposition
+        when it is exact for this simulator (``_probe_delta_ok``). The
+        deltas recompute the handler's merge as a PURE probe over the same
+        peer gather — deterministic, so it equals what ``handler.call``
+        merged regardless of which delivery path (wide/compact/fused) ran.
+        ``state`` is the post-receive state (its history ring — the gather
+        source — is not touched by receives); ``pre_model`` the slot's
+        pre-receive model."""
+        pa = pa.record_slot(apply_mask, r - send_round)
+        if not self._probe_delta_ok:
+            return pa
+
+        def deltas():
+            peer = self._gather_peer(state, send_round, sender)
+            extra_arg = self._decode_extra(extra)
+            merged = jax.vmap(
+                self.handler.merge,
+                in_axes=(0, 0, 0 if extra_arg is not None else None))(
+                pre_model, peer, extra_arg)
+            merged_p = select_nodes(apply_mask, merged.params,
+                                    pre_model.params)
+            return (sq_param_distance(merged_p, pre_model.params),
+                    sq_param_distance(state.model.params, merged_p))
+
+        m_sq, t_sq = jax.lax.cond(
+            apply_mask.any(), deltas,
+            lambda: (jnp.float32(0), jnp.float32(0)))
+        return pa._replace(merge_sq=pa.merge_sq + m_sq,
+                           train_sq=pa.train_sq + t_sq)
+
+    def _probe_round_stats(self, state: SimState,
+                           pa: Optional[ProbeAccum]) -> dict:
+        """The round's ``probe_*`` stats entries (traced), from the final
+        round state and the slot-loop accumulator."""
+        cfg = self.probes
+        out: dict = {}
+        if cfg.consensus:
+            cm, cx, cl = consensus_stats(state.model.params)
+            out["probe_consensus_mean"] = cm
+            out["probe_consensus_max"] = cx
+            out["probe_consensus_per_layer"] = cl
+        if pa is not None:
+            out.update(probe_stats_from_accum(cfg, pa,
+                                              self._probe_delta_ok))
+        return out
+
+    def _probe_expected_fanin(self) -> np.ndarray:
+        """Host-side [N] expected ACCEPTED merges per node per round, the
+        comparison baseline for ``probe_accepted_per_node``: the
+        topology's expected fan-in thinned by the drop and online rates
+        (both gate acceptance). Variants with different traffic shapes
+        (broadcast mixing) override."""
+        return (self._lam_vector() * (1.0 - self.drop_prob)
+                * self.online_prob)
+
+    def _probe_layer_names(self) -> list[str]:
+        """Leaf names matching ``probe_consensus_per_layer`` columns
+        (shape-only handler init; host-side)."""
+        st = jax.eval_shape(self.handler.init, jax.random.PRNGKey(0))
+        return param_layer_names(st.params)
+
     def _deliver_phase(self, state: SimState, base_key, r):
         n = self.n_nodes
         D = state.history_ages.shape[0]
@@ -1190,9 +1310,16 @@ class GossipSimulator(SimulationEventSender):
         # for CNN configs). Slot index k is TRACED: it feeds fold_in key
         # derivation, dynamic slot reads, and the _post_receive_slot hook —
         # subclass hooks must treat k as an array, not a Python int.
+        probes_on = self._probe_slots_on()
+
         def slot_body(k, carry):
-            state, fails, n_sent_replies, reply_size_total, \
-                n_compact, n_wide = carry
+            if probes_on:
+                state, fails, n_sent_replies, reply_size_total, \
+                    n_compact, n_wide, pa = carry
+            else:
+                state, fails, n_sent_replies, reply_size_total, \
+                    n_compact, n_wide = carry
+                pa = None
             sender = jnp.take(state.mailbox.sender[b], k, axis=1)
             sr = jnp.take(state.mailbox.send_round[b], k, axis=1)
             ty = jnp.take(state.mailbox.msg_type[b], k, axis=1)
@@ -1210,6 +1337,8 @@ class GossipSimulator(SimulationEventSender):
             dc, dw = self._delivery_path_counts(apply_mask)
             n_compact += dc
             n_wide += dw
+            if probes_on:
+                pre_model = state.model
             # Higher slots are empty most rounds (at most ~1 push per
             # receiver per round in the base protocol); a cond lets the
             # compiled program skip the whole merge+train pass for an
@@ -1220,6 +1349,9 @@ class GossipSimulator(SimulationEventSender):
                                                     apply_mask, call_key),
                 lambda st: st,
                 state)
+            if probes_on:
+                pa = self._probe_slot_update(pa, state, pre_model, sr,
+                                             sender, extra, apply_mask, r)
 
             if self._replies_possible():
                 wants_reply = (ty == MessageType.PULL) | (ty == MessageType.PUSH_PULL)
@@ -1248,19 +1380,24 @@ class GossipSimulator(SimulationEventSender):
 
             state = self._post_receive_slot(state, valid, ty, sender, sr,
                                             extra, base_key, r, k)
-            return state, fails, n_sent_replies, reply_size_total, \
-                n_compact, n_wide
+            out = (state, fails, n_sent_replies, reply_size_total,
+                   n_compact, n_wide)
+            return out + ((pa,) if probes_on else ())
 
+        init = (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0),
+                jnp.int32(0), jnp.int32(0))
+        if probes_on:
+            init = init + (self._probe_zero_accum(),)
+        carry = jax.lax.fori_loop(0, self.K, slot_body, init)
         state, fails, n_sent_replies, reply_size_total, n_compact, n_wide = \
-            jax.lax.fori_loop(
-                0, self.K, slot_body,
-                (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0),
-                 jnp.int32(0), jnp.int32(0)))
+            carry[:6]
 
         state = state._replace(mailbox=state.mailbox.clear_cell(b))
         state, ex_sent, ex_fails, ex_size = self._post_deliver(state, base_key, r)
         diag = {"mailbox_hwm": hwm, "compact_slots": n_compact,
                 "wide_slots": n_wide}
+        if probes_on:
+            diag["probe_accum"] = carry[6]
         return state, n_sent_replies + ex_sent, fails + ex_fails, \
             reply_size_total + ex_size, diag
 
@@ -1294,16 +1431,23 @@ class GossipSimulator(SimulationEventSender):
         return self.protocol != AntiEntropyProtocol.PUSH
 
     def _reply_phase(self, state: SimState, base_key, r):
+        probes_on = self._probe_slots_on()
         if not self._replies_possible():
-            return state, FailureCounts.zeros(), \
-                {"compact_slots": jnp.int32(0), "wide_slots": jnp.int32(0)}
+            diag = {"compact_slots": jnp.int32(0), "wide_slots": jnp.int32(0)}
+            if probes_on:
+                diag["probe_accum"] = self._probe_zero_accum()
+            return state, FailureCounts.zeros(), diag
         n = self.n_nodes
         D = state.history_ages.shape[0]
         b = r % D
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE * 7 + 3), self.online_prob, (n,))
         def slot_body(k, carry):
-            state, fails, n_compact, n_wide = carry
+            if probes_on:
+                state, fails, n_compact, n_wide, pa = carry
+            else:
+                state, fails, n_compact, n_wide = carry
+                pa = None
             sender = jnp.take(state.reply_box.sender[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
@@ -1315,20 +1459,30 @@ class GossipSimulator(SimulationEventSender):
             dc, dw = self._delivery_path_counts(valid)
             n_compact += dc
             n_wide += dw
+            if probes_on:
+                pre_model = state.model
             state = jax.lax.cond(
                 valid.any(),
                 lambda st: self._receive_slot_apply(st, sr_k, sender, extra_k,
                                                     valid, call_key),
                 lambda st: st,
                 state)
-            return state, fails, n_compact, n_wide
+            if probes_on:
+                pa = self._probe_slot_update(pa, state, pre_model, sr_k,
+                                             sender, extra_k, valid, r)
+            out = (state, fails, n_compact, n_wide)
+            return out + ((pa,) if probes_on else ())
 
-        state, fails, n_compact, n_wide = jax.lax.fori_loop(
-            0, self.Kr, slot_body,
-            (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0)))
+        init = (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0))
+        if probes_on:
+            init = init + (self._probe_zero_accum(),)
+        carry = jax.lax.fori_loop(0, self.Kr, slot_body, init)
+        state, fails, n_compact, n_wide = carry[:4]
         state = state._replace(reply_box=state.reply_box.clear_cell(b))
-        return state, fails, \
-            {"compact_slots": n_compact, "wide_slots": n_wide}
+        diag = {"compact_slots": n_compact, "wide_slots": n_wide}
+        if probes_on:
+            diag["probe_accum"] = carry[4]
+        return state, fails, diag
 
     # -- evaluation ---------------------------------------------------------
 
@@ -1451,6 +1605,11 @@ class GossipSimulator(SimulationEventSender):
             "local": local,
             "global": glob,
         }
+        if self.probes is not None:
+            pa = None
+            if self._probe_slots_on():
+                pa = diag["probe_accum"] + reply_diag["probe_accum"]
+            stats.update(self._probe_round_stats(state, pa))
         return state, stats
 
     # -- public API ---------------------------------------------------------
@@ -1462,14 +1621,19 @@ class GossipSimulator(SimulationEventSender):
         ``_live_round_times`` — the basis for the report's per-round timing
         and rounds/sec EMA when the run is live."""
         names = self._metric_keys()
+        # Probe values ride the same ordered callback (fixed key order so
+        # the host side can rebuild the dict from positional operands).
+        probe_keys = [k for k in PROBE_STAT_KEYS if k in stats]
 
-        def cb(rnd, sent, failed, drop, offline, overflow, size, local, glob):
+        def cb(rnd, sent, failed, drop, offline, overflow, size, local,
+               glob, *probe_vals):
             import time as _time
             times = getattr(self, "_live_round_times", None)
             if times is not None:
                 times.append(_time.perf_counter())
             causes = {"drop": int(drop), "offline": int(offline),
                       "overflow": int(overflow)}
+            probes = probe_event_row(dict(zip(probe_keys, probe_vals)))
 
             def row(vals):
                 if np.all(np.isnan(vals)):
@@ -1477,13 +1641,13 @@ class GossipSimulator(SimulationEventSender):
                 return {k: float(v) for k, v in zip(names, vals)}
             self._notify_round(int(rnd), int(sent), int(failed), int(size),
                                row(local), row(glob), live_only=True,
-                               causes=causes)
+                               causes=causes, probes=probes)
 
         jax.experimental.io_callback(
             cb, None, state.round, stats["sent"], stats["failed"],
             stats["failed_drop"], stats["failed_offline"],
             stats["failed_overflow"], stats["size"], stats["local"],
-            stats["global"], ordered=True)
+            stats["global"], *[stats[k] for k in probe_keys], ordered=True)
 
     def _cache_salt(self):
         """Extra jit-cache key component for variants whose trace depends on
@@ -1648,7 +1812,14 @@ class GossipSimulator(SimulationEventSender):
             failed_by_cause = {"drop": np.asarray(stats["failed_drop"]),
                                "offline": np.asarray(stats["failed_offline"]),
                                "overflow": np.asarray(stats["failed_overflow"])}
-        return SimulationReport(
+        extras = {k: opt(k) for k in PROBE_STAT_KEYS if k in stats}
+        if self.probes is not None:
+            if self.probes.consensus:
+                extras["probe_layer_names"] = self._probe_layer_names()
+            if self.probes.mixing:
+                extras["probe_expected_fanin"] = np.asarray(
+                    self._probe_expected_fanin(), np.float64)
+        report = SimulationReport(
             metric_names=self._metric_keys(),
             local_evals=np.asarray(stats["local"]) if self.has_local_test else None,
             global_evals=np.asarray(stats["global"]) if self.has_global_eval else None,
@@ -1659,7 +1830,28 @@ class GossipSimulator(SimulationEventSender):
             mailbox_hwm=opt("mailbox_hwm"),
             compact_slots=opt("compact_slots"),
             wide_slots=opt("wide_slots"),
+            **extras,
         )
+        if self.probes is not None:
+            self._emit_probe_summary(report)
+        return report
+
+    def _emit_probe_summary(self, report: SimulationReport) -> None:
+        """One structured telemetry event per built report summarizing the
+        run's gossip dynamics (the per-round detail lives in the report
+        and the ``update_probes`` event stream)."""
+        data: dict = {"simulator": type(self).__name__,
+                      "probes": self.probes.to_dict()}
+        cm = report.probe_consensus_mean
+        if cm is not None and len(cm):
+            data["consensus_first"] = float(cm[0])
+            data["consensus_last"] = float(cm[-1])
+        if report.probe_stale_max is not None and len(report.probe_stale_max):
+            data["stale_max"] = int(np.max(report.probe_stale_max))
+        acc = report.probe_accepted_per_node
+        if acc is not None:
+            data["accepted_total"] = int(np.sum(acc))
+        emit_event("probes_summary", data)
 
     def run_repetitions(self, n_rounds: int, keys: jax.Array,
                         local_train: bool = True, common_init: bool = False,

@@ -63,6 +63,16 @@ class SimulationEventReceiver:
         round has no per-message host boundary; ``msg`` is a
         :class:`~gossipy_tpu.simulation.sequential.MessageRecord`."""
 
+    def update_probes(self, round: int, probes: dict) -> None:
+        """Per-round gossip-dynamics probe values (fired only by runs with
+        ``probes=`` enabled; see :mod:`gossipy_tpu.telemetry.probes`).
+        ``probes`` carries the JSON-able per-round summary — subsets of
+        ``consensus_mean``/``consensus_max``, ``stale_mean``/``stale_max``/
+        ``stale_hist``, ``accepted_total``, ``merge_delta``/``train_delta``
+        (None when the decomposition is not exact for the simulator) —
+        depending on which probes are on. Fired after
+        ``update_failure_causes``, live and replayed alike."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""
@@ -100,7 +110,8 @@ class SimulationEventSender:
                       local: Optional[dict], glob: Optional[dict],
                       live_only: bool = False,
                       include_live: bool = False,
-                      causes: Optional[dict] = None) -> None:
+                      causes: Optional[dict] = None,
+                      probes: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
@@ -109,6 +120,8 @@ class SimulationEventSender:
             r.update_message(round, sent, failed, size)
             if causes is not None:
                 r.update_failure_causes(round, causes)
+            if probes is not None:
+                r.update_probes(round, probes)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -137,6 +150,9 @@ class SimulationEventSender:
         if "failed_drop" in stats:
             cause_arrs = {c: np.asarray(stats["failed_" + c])
                           for c in ("drop", "offline", "overflow")}
+        from ..telemetry.probes import PROBE_STAT_KEYS, probe_event_row
+        probe_arrs = {k: np.asarray(stats[k]) for k in PROBE_STAT_KEYS
+                      if k in stats}
 
         def row(arr, i):
             vals = arr[i]
@@ -147,10 +163,12 @@ class SimulationEventSender:
         for i in range(sent.shape[0]):
             causes = ({c: int(a[i]) for c, a in cause_arrs.items()}
                       if cause_arrs is not None else None)
+            probes = probe_event_row({k: a[i] for k, a in probe_arrs.items()})
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
-                               include_live=include_live, causes=causes)
+                               include_live=include_live, causes=causes,
+                               probes=probes)
         self._notify_end()
 
 
@@ -205,22 +223,32 @@ class JSONLinesReceiver(SimulationEventReceiver):
     reference lists as an open TODO ("Weights and Biases support",
     README.md:50), kept tool-agnostic: any dashboard can tail the .jsonl.
 
-    Line schema (``"schema": 2``), one object per round::
+    Line schema (``"schema": 3``), one object per round — versions are
+    strictly additive, so a reader written against any version parses
+    every later one by ignoring unknown keys (and every earlier one via
+    :meth:`parse_line`, which fills absent fields with null):
 
-        {
-          "schema": 2,            # line-format version (1 had no causes)
-          "round": int,           # 1-based round number
-          "sent": int,            # messages generated this round
-          "failed": int,          # messages lost this round (all causes)
-          "failed_by_cause": {    # breakdown; values sum to "failed";
-            "drop": int,          #   null from engines without causes
-            "offline": int,
-            "overflow": int
-          } | null,
-          "size": int,            # total scalars shipped this round
-          "local":  {metric: mean} | null,   # per-user test sets
-          "global": {metric: mean} | null    # global eval set
-        }
+        ======= =================== =====================================
+        since   field               meaning
+        ======= =================== =====================================
+        v1      ``schema``          line-format version int
+        v1      ``round``           1-based round number
+        v1      ``sent``            messages generated this round
+        v1      ``failed``          messages lost this round (all causes)
+        v1      ``size``            total scalars shipped this round
+        v1      ``local``           ``{metric: mean} | null`` (user tests)
+        v1      ``global``          ``{metric: mean} | null`` (global set)
+        v2      ``failed_by_cause`` ``{drop, offline, overflow} | null``;
+                                    values sum to ``failed``
+        v3      ``probes``          gossip-dynamics probe row ``| null``:
+                                    subsets of ``consensus_mean``,
+                                    ``consensus_max``, ``stale_mean``,
+                                    ``stale_max``, ``stale_hist`` (list),
+                                    ``accepted_total``, ``merge_delta``,
+                                    ``train_delta`` per the run's
+                                    ``ProbeConfig`` (null without
+                                    ``probes=``)
+        ======= =================== =====================================
 
     Works replayed (default) or live (``live=True`` streams rows during the
     jitted run through the ordered io_callback).
@@ -232,7 +260,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
-    SCHEMA = 2
+    SCHEMA = 3
 
     def __init__(self, path: str, live: bool = False):
         import json
@@ -245,10 +273,14 @@ class JSONLinesReceiver(SimulationEventReceiver):
     def update_message(self, round, sent, failed, size):
         self._row = {"schema": self.SCHEMA, "round": round, "sent": sent,
                      "failed": failed, "failed_by_cause": None,
-                     "size": size, "local": None, "global": None}
+                     "size": size, "probes": None,
+                     "local": None, "global": None}
 
     def update_failure_causes(self, round, causes):
         self._row["failed_by_cause"] = dict(causes)
+
+    def update_probes(self, round, probes):
+        self._row["probes"] = dict(probes)
 
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics
@@ -258,6 +290,22 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     def update_end(self):
         self._fh.flush()
+
+    @classmethod
+    def parse_line(cls, line: str) -> dict:
+        """Version-tolerant row reader: normalize a v1/v2/v3 line into the
+        CURRENT schema's shape (fields a line's version predates come back
+        null, unknown future fields pass through untouched). The one
+        reader consumers should use instead of re-encoding the version
+        history themselves."""
+        import json
+        row = json.loads(line)
+        schema = row.get("schema", 1)
+        if schema < 2:
+            row.setdefault("failed_by_cause", None)
+        if schema < 3:
+            row.setdefault("probes", None)
+        return row
 
     def close(self):
         self._fh.close()

@@ -17,10 +17,22 @@ Telemetry extensions beyond the reference's report:
   ``wide_slots_per_round``: mailbox occupancy high-water mark and the
   compact-vs-wide delivery-path indicator (engine runs only; None from
   engines without a mailbox).
+- gossip-dynamics probe arrays (``probe_*``; present when the run was
+  started with ``probes=`` — see :mod:`gossipy_tpu.telemetry.probes`):
+  consensus distance (mean/max/per-layer), merge-staleness distribution
+  (mean/max/histogram), per-node accepted-merge counts and the
+  merge-delta vs train-delta norms.
 - ``wall_clock_seconds_per_round`` / ``rounds_per_sec_ema``: host timing
   captured through the live io_callback path (None for non-live runs).
-- ``to_dict()`` / ``save(path)``: a JSON-able run record (strict JSON:
-  NaN metric rows become nulls).
+- ``to_dict()`` / ``save(path)`` / ``from_dict()`` / ``load(path)``: a
+  JSON-able, round-trippable run record (strict JSON: NaN rows → nulls).
+
+Optional per-round arrays are REGISTRY-driven (:data:`PER_ROUND_FIELDS` /
+:data:`STATIC_FIELDS`): ``to_dict``, ``from_dict`` and ``concatenate`` all
+iterate the registry, so a newly added per-round array can never be
+silently dropped by one of them — adding a field is one registry line
+(tests assert every array attribute survives the
+save → load → concatenate round trip).
 """
 
 from __future__ import annotations
@@ -30,7 +42,44 @@ from typing import Optional
 
 import numpy as np
 
-REPORT_SCHEMA = 2  # 1: sent/failed/size/evals; 2: + cause breakdown & diag
+# 1: sent/failed/size/evals; 2: + cause breakdown & mailbox/compact diag;
+# 3: + gossip-dynamics probe arrays (probe_*) and the static probe context.
+REPORT_SCHEMA = 3
+
+# Optional per-round arrays (attribute name == JSON key), concatenated
+# along axis 0 by :meth:`SimulationReport.concatenate` (surviving only
+# when EVERY segment carries them) and round-tripped by
+# ``to_dict``/``from_dict``. int-valued entries round-trip as ints; float
+# entries may carry NaN (serialized as null).
+PER_ROUND_FIELDS = (
+    "mailbox_hwm_per_round",
+    "compact_slots_per_round",
+    "wide_slots_per_round",
+    "probe_consensus_mean",          # [R] f32
+    "probe_consensus_max",           # [R] f32
+    "probe_consensus_per_layer",     # [R, L] f32
+    "probe_stale_mean",              # [R] f32
+    "probe_stale_max",               # [R] i32
+    "probe_stale_hist",              # [R, B] i32; rows sum to accepted count
+    "probe_accepted_per_node",       # [R, N] i32
+    "probe_merge_delta",             # [R] f32 (NaN when not decomposable)
+    "probe_train_delta",             # [R] f32
+    "wall_clock_seconds_per_round",  # [R] f64 (live runs only)
+)
+
+# Static (non-per-round) optional fields: carried from the FIRST segment by
+# ``concatenate`` and round-tripped verbatim by ``to_dict``/``from_dict``.
+STATIC_FIELDS = (
+    "probe_layer_names",      # [L] list[str]: consensus per-layer ordering
+    "probe_expected_fanin",   # [N] f64: topology's expected accepted fan-in
+)
+
+# Integer-valued per-round fields (restored as int arrays by from_dict).
+_INT_FIELDS = frozenset({
+    "mailbox_hwm_per_round", "compact_slots_per_round",
+    "wide_slots_per_round", "probe_stale_max", "probe_stale_hist",
+    "probe_accepted_per_node",
+})
 
 
 class SimulationReport:
@@ -49,6 +98,9 @@ class SimulationReport:
       per-round sum equals ``failed``
     - ``mailbox_hwm`` / ``compact_slots`` / ``wide_slots``: optional [R]
       engine diagnostics (see the engine's ``_deliver_phase``)
+    - ``**extras``: any field named in :data:`PER_ROUND_FIELDS` /
+      :data:`STATIC_FIELDS` (the probe arrays land here); unknown names
+      raise.
     """
 
     def __init__(self,
@@ -61,7 +113,8 @@ class SimulationReport:
                  failed_by_cause: Optional[dict] = None,
                  mailbox_hwm: Optional[np.ndarray] = None,
                  compact_slots: Optional[np.ndarray] = None,
-                 wide_slots: Optional[np.ndarray] = None):
+                 wide_slots: Optional[np.ndarray] = None,
+                 **extras):
         self.metric_names = list(metric_names)
         self._local = local_evals
         self._global = global_evals
@@ -73,14 +126,25 @@ class SimulationReport:
         self.failed_per_cause: Optional[dict] = (
             {k: np.asarray(v) for k, v in failed_by_cause.items()}
             if failed_by_cause is not None else None)
-        self.mailbox_hwm_per_round = (
-            np.asarray(mailbox_hwm) if mailbox_hwm is not None else None)
-        self.compact_slots_per_round = (
-            np.asarray(compact_slots) if compact_slots is not None else None)
-        self.wide_slots_per_round = (
-            np.asarray(wide_slots) if wide_slots is not None else None)
-        # Host wall-clock (live io_callback runs only; attach_wall_clock).
-        self.wall_clock_seconds_per_round: Optional[np.ndarray] = None
+        # Registry-driven optional fields: every name defaults to None,
+        # then the legacy named params and **extras fill them in.
+        for name in PER_ROUND_FIELDS + STATIC_FIELDS:
+            setattr(self, name, None)
+        legacy = {"mailbox_hwm_per_round": mailbox_hwm,
+                  "compact_slots_per_round": compact_slots,
+                  "wide_slots_per_round": wide_slots}
+        for name, val in {**legacy, **extras}.items():
+            if name not in PER_ROUND_FIELDS and name not in STATIC_FIELDS:
+                raise TypeError(
+                    f"unknown report field {name!r}; add it to "
+                    "PER_ROUND_FIELDS/STATIC_FIELDS so to_dict/concatenate "
+                    "cannot silently drop it")
+            if val is None:
+                continue
+            if name in PER_ROUND_FIELDS:
+                val = np.asarray(val)
+            setattr(self, name, val)
+        # Host wall-clock EMA (live io_callback runs only; attach_wall_clock).
         self.rounds_per_sec_ema: Optional[float] = None
 
     def attach_wall_clock(self, t_start: float, round_times: list,
@@ -156,7 +220,9 @@ class SimulationReport:
 
     def to_dict(self) -> dict:
         """The full run record as JSON-able primitives (strict JSON: every
-        NaN — skipped-eval metric rows — becomes null)."""
+        NaN — skipped-eval metric rows, non-decomposable probe deltas —
+        becomes null). Optional per-round/static fields are emitted from
+        the module registry, so new fields cannot be forgotten here."""
         def scrub(x):
             if isinstance(x, list):
                 return [scrub(v) for v in x]
@@ -166,7 +232,7 @@ class SimulationReport:
 
         def arr(a):
             return None if a is None else scrub(np.asarray(a).tolist())
-        return {
+        out = {
             "schema": REPORT_SCHEMA,
             "metric_names": self.metric_names,
             "sent_messages": self.sent_messages,
@@ -177,15 +243,17 @@ class SimulationReport:
             "failed_per_cause": (
                 {k: arr(v) for k, v in self.failed_per_cause.items()}
                 if self.failed_per_cause is not None else None),
-            "mailbox_hwm_per_round": arr(self.mailbox_hwm_per_round),
-            "compact_slots_per_round": arr(self.compact_slots_per_round),
-            "wide_slots_per_round": arr(self.wide_slots_per_round),
             "local_evals": arr(self._local),
             "global_evals": arr(self._global),
-            "wall_clock_seconds_per_round":
-                arr(self.wall_clock_seconds_per_round),
             "rounds_per_sec_ema": self.rounds_per_sec_ema,
         }
+        for name in PER_ROUND_FIELDS:
+            out[name] = arr(getattr(self, name))
+        for name in STATIC_FIELDS:
+            val = getattr(self, name)
+            out[name] = (arr(val) if isinstance(val, np.ndarray)
+                         else scrub(val) if isinstance(val, list) else val)
+        return out
 
     def save(self, path: str) -> str:
         """Write :meth:`to_dict` as JSON to ``path``."""
@@ -195,16 +263,67 @@ class SimulationReport:
         return path
 
     @classmethod
+    def from_dict(cls, d: dict) -> "SimulationReport":
+        """Rebuild a report from :meth:`to_dict` output (any schema
+        version; absent fields come back None, nulls inside float arrays
+        come back NaN)."""
+        def unscrub(x):
+            if isinstance(x, list):
+                return [unscrub(v) for v in x]
+            return np.nan if x is None else x
+
+        def farr(v):
+            return None if v is None else np.asarray(unscrub(v), np.float64)
+
+        def opt(name):
+            v = d.get(name)
+            if v is None:
+                return None
+            if name in _INT_FIELDS:
+                return np.asarray(v, np.int64)
+            return np.asarray(unscrub(v), np.float64)
+
+        causes = d.get("failed_per_cause")
+        extras = {name: opt(name) for name in PER_ROUND_FIELDS}
+        for name in STATIC_FIELDS:
+            v = d.get(name)
+            if v is None:
+                continue
+            extras[name] = (np.asarray(v, np.float64)
+                            if name == "probe_expected_fanin" else list(v))
+        rep = cls(
+            metric_names=list(d["metric_names"]),
+            local_evals=farr(d.get("local_evals")),
+            global_evals=farr(d.get("global_evals")),
+            sent=np.asarray(d["sent_per_round"], np.int64),
+            failed=np.asarray(d["failed_per_round"], np.int64),
+            total_size=int(d["total_size"]),
+            failed_by_cause=({k: np.asarray(v, np.int64)
+                              for k, v in causes.items()}
+                             if causes is not None else None),
+            **{k: v for k, v in extras.items() if v is not None})
+        if d.get("rounds_per_sec_ema") is not None:
+            rep.rounds_per_sec_ema = float(d["rounds_per_sec_ema"])
+        return rep
+
+    @classmethod
+    def load(cls, path: str) -> "SimulationReport":
+        """Read a report written by :meth:`save`."""
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+    @classmethod
     def concatenate(cls, reports: list) -> "SimulationReport":
         """Stitch consecutive run segments (e.g. the PENS phase split) into
-        one report; optional per-round arrays survive only when EVERY
-        segment carries them."""
+        one report. Optional per-round arrays (module registry) survive
+        only when EVERY segment carries them; static fields carry over
+        from the first segment."""
         def cat(arrs):
             arrs = [a for a in arrs if a is not None]
             return np.concatenate(arrs) if arrs else None
 
         def cat_all(key):
-            vals = [getattr(r, key) for r in reports]
+            vals = [getattr(r, key, None) for r in reports]
             if any(v is None for v in vals):
                 return None
             return np.concatenate(vals)
@@ -214,6 +333,9 @@ class SimulationReport:
             keys = reports[0].failed_per_cause.keys()
             causes = {k: np.concatenate([r.failed_per_cause[k]
                                          for r in reports]) for k in keys}
+        extras = {name: cat_all(name) for name in PER_ROUND_FIELDS}
+        for name in STATIC_FIELDS:
+            extras[name] = getattr(reports[0], name, None)
         return cls(
             metric_names=reports[0].metric_names,
             local_evals=cat([r._local for r in reports]),
@@ -222,10 +344,7 @@ class SimulationReport:
             failed=np.concatenate([r.failed_per_round for r in reports]),
             total_size=sum(r.total_size for r in reports),
             failed_by_cause=causes,
-            mailbox_hwm=cat_all("mailbox_hwm_per_round"),
-            compact_slots=cat_all("compact_slots_per_round"),
-            wide_slots=cat_all("wide_slots_per_round"),
-        )
+            **{k: v for k, v in extras.items() if v is not None})
 
     def __str__(self) -> str:
         return json.dumps({

@@ -48,9 +48,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import AntiEntropyProtocol, ConstantDelay, Delay, MessageType
+from ..core import AntiEntropyProtocol, ConstantDelay, CreateModelMode, \
+    Delay, MessageType
 from ..flow_control import TokenAccount
 from ..handlers.base import BaseHandler, ModelState, PeerModel
+from ..telemetry.probes import (
+    ProbeConfig,
+    consensus_stats,
+    param_layer_names,
+    sq_param_distance,
+)
 from .engine import PROTO_TO_MSG
 from .events import SimulationEventSender
 from .report import SimulationReport
@@ -111,7 +118,8 @@ class SequentialGossipSimulator(SimulationEventSender):
                  sampling_eval: float = 0.0,
                  sync: bool = True,
                  token_account: Optional[TokenAccount] = None,
-                 utility_fun: Optional[Callable] = None):
+                 utility_fun: Optional[Callable] = None,
+                 probes=None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         self.handler = handler
         self.topology = topology
@@ -156,6 +164,18 @@ class SequentialGossipSimulator(SimulationEventSender):
         self._jit_call = jax.jit(handler.call)
         self._jit_update = jax.jit(handler.update)
         self._jit_eval_batch = jax.jit(jax.vmap(handler.evaluate))
+        # Gossip-dynamics probes (telemetry.probes): the SAME quantities
+        # the jitted engine computes in-graph, here accumulated eagerly per
+        # message/round — the verification side of probe-parity tests.
+        self.probes: Optional[ProbeConfig] = ProbeConfig.coerce(probes)
+        self._probe_delta_ok = (
+            self.probes is not None and self.probes.mixing
+            and handler.mode == CreateModelMode.MERGE_UPDATE)
+        if self._probe_delta_ok:
+            self._jit_merge = jax.jit(handler.merge)
+        if self.probes is not None:
+            self._jit_sqdist = jax.jit(sq_param_distance)
+            self._jit_consensus = jax.jit(consensus_stats)
 
         def eval_global(stacked, xe, ye, me):
             return jax.vmap(lambda m: handler.evaluate(m, (xe, ye, me)))(
@@ -253,6 +273,21 @@ class SequentialGossipSimulator(SimulationEventSender):
         size_pr = np.zeros(n_rounds, np.int64)
         local_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
         global_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
+        # Per-round probe accumulators (same definitions as the jitted
+        # engine's traced ProbeAccum; telemetry.probes).
+        probes = self.probes
+        if probes is not None:
+            B = probes.staleness_buckets
+            acc_pr = np.zeros((n_rounds, n), np.int64)
+            stale_sum_pr = np.zeros(n_rounds, np.int64)
+            stale_max_pr = np.zeros(n_rounds, np.int64)
+            stale_hist_pr = np.zeros((n_rounds, B), np.int64)
+            merge_sq_pr = np.zeros(n_rounds, np.float64)
+            train_sq_pr = np.zeros(n_rounds, np.float64)
+            n_layers = len(param_layer_names(state.models[0].params))
+            cons_mean = np.zeros(n_rounds, np.float64)
+            cons_max = np.zeros(n_rounds, np.float64)
+            cons_layers = np.zeros((n_rounds, n_layers), np.float64)
         # ONE monotonically increasing event counter feeds every jax-side
         # draw (handler calls, delay samples): each draw gets a globally
         # unique fold, so no two events — same tick, same sender, or
@@ -319,9 +354,31 @@ class SequentialGossipSimulator(SimulationEventSender):
             wants_reply = p.rec.msg_type in (MessageType.PULL,
                                              MessageType.PUSH_PULL)
             if carries_model:
-                state.models[i] = self._jit_call(
-                    state.models[i], p.payload, self._node_data(i),
-                    next_key(), None)
+                if probes is not None:
+                    # Accepted model-carrying merge: staleness in ROUNDS
+                    # since the payload's model was captured (0 at zero
+                    # delay), clamped into the histogram's last bucket —
+                    # identical bookkeeping to ProbeAccum.record_slot.
+                    stale = max(r - p.rec.round, 0)
+                    acc_pr[r, i] += 1
+                    stale_sum_pr[r] += stale
+                    stale_max_pr[r] = max(stale_max_pr[r], stale)
+                    stale_hist_pr[r, min(stale, B - 1)] += 1
+                if self._probe_delta_ok:
+                    before = state.models[i]
+                    merged = self._jit_merge(before, p.payload)
+                    new = self._jit_call(before, p.payload,
+                                         self._node_data(i), next_key(),
+                                         None)
+                    merge_sq_pr[r] += float(self._jit_sqdist(
+                        merged.params, before.params))
+                    train_sq_pr[r] += float(self._jit_sqdist(
+                        new.params, merged.params))
+                    state.models[i] = new
+                else:
+                    state.models[i] = self._jit_call(
+                        state.models[i], p.payload, self._node_data(i),
+                        next_key(), None)
             if wants_reply and not p.is_reply:
                 # Reply carries the receiver's CURRENT (possibly just
                 # merged) model — the sequential semantics the bulk engine
@@ -386,21 +443,68 @@ class SequentialGossipSimulator(SimulationEventSender):
                     local_rows[r] = loc
                 if glob is not None:
                     global_rows[r] = glob
+                if probes is not None and probes.consensus:
+                    stacked = jax.tree.map(lambda *ls: jnp.stack(ls),
+                                           *state.models)
+                    cm, cx, cl = self._jit_consensus(stacked.params)
+                    cons_mean[r] = float(cm)
+                    cons_max[r] = float(cx)
+                    cons_layers[r] = np.asarray(cl)
                 state.round += 1
 
+        extras: dict = {}
+        if probes is not None:
+            if probes.consensus:
+                extras["probe_consensus_mean"] = cons_mean
+                extras["probe_consensus_max"] = cons_max
+                extras["probe_consensus_per_layer"] = cons_layers
+                extras["probe_layer_names"] = param_layer_names(
+                    state.models[0].params)
+            if probes.staleness:
+                counts = stale_hist_pr.sum(axis=1)
+                extras["probe_stale_mean"] = (
+                    stale_sum_pr / np.maximum(counts, 1)).astype(np.float64)
+                extras["probe_stale_max"] = stale_max_pr
+                extras["probe_stale_hist"] = stale_hist_pr
+            if probes.mixing:
+                extras["probe_accepted_per_node"] = acc_pr
+                if self._probe_delta_ok:
+                    extras["probe_merge_delta"] = np.sqrt(merge_sq_pr)
+                    extras["probe_train_delta"] = np.sqrt(train_sq_pr)
+                else:
+                    nan_pr = np.full(n_rounds, np.nan)
+                    extras["probe_merge_delta"] = nan_pr
+                    extras["probe_train_delta"] = nan_pr.copy()
+                extras["probe_expected_fanin"] = self._probe_expected_fanin()
         report = SimulationReport(
             metric_names=names,
             local_evals=local_rows if self.has_local_test else None,
             global_evals=global_rows if self.has_global_eval else None,
             sent=sent_pr, failed=failed_pr, total_size=int(size_pr.sum()),
             failed_by_cause={"drop": drop_pr, "offline": offline_pr,
-                             "overflow": overflow_pr})
+                             "overflow": overflow_pr},
+            **extras)
         self.replay_events(state.round - n_rounds, {
             "sent": sent_pr, "failed": failed_pr,
             "failed_drop": drop_pr, "failed_offline": offline_pr,
             "failed_overflow": overflow_pr, "size": size_pr,
-            "local": local_rows, "global": global_rows}, names)
+            "local": local_rows, "global": global_rows,
+            # Per-round probe arrays ride the same replay so receivers get
+            # update_probes from this engine too (static context excluded).
+            **{k: v for k, v in extras.items()
+               if k not in ("probe_layer_names", "probe_expected_fanin")}},
+            names)
         return state, report
+
+    def _probe_expected_fanin(self) -> np.ndarray:
+        """[N] expected accepted merges per node per round under this
+        engine's uniform neighbor-list sampling (the jitted engine's
+        ``_expected_fanin_vector`` semantics), thinned by drop/online."""
+        lam = np.zeros(self.n_nodes)
+        for j, nb in enumerate(self._nbrs):
+            if len(nb):
+                np.add.at(lam, np.asarray(nb), 1.0 / len(nb))
+        return lam * (1.0 - self.drop_prob) * self.online_prob
 
     def run_repetitions(self, n_rounds: int, keys,
                         local_train: bool = True,

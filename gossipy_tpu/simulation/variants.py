@@ -23,6 +23,7 @@ from ..telemetry import (
     PHASE_TRAIN,
     FailureCounts,
 )
+from ..telemetry.probes import consensus_stats, sq_param_distance
 from .engine import GossipSimulator, PROTO_TO_MSG, SimState, select_nodes
 from .nodes import PartitioningGossipSimulator
 
@@ -302,6 +303,16 @@ class All2AllGossipSimulator(GossipSimulator):
 
     def _round(self, state: SimState, base_key: jax.Array, last_round=None):
         r = state.round
+        # Probe plumbing (opt-in; None traces the exact pre-feature round).
+        # Broadcast mixing has no mailbox: every contribution is a
+        # same-round round-start snapshot, so staleness is structurally 0
+        # and the accepted-merge count is the per-node count of live
+        # incoming weighted edges. The merge/train delta split is exact
+        # here — the mix and the local update are separate phases.
+        probe_mix = self.probes is not None and (self.probes.mixing
+                                                 or self.probes.staleness)
+        acc_count = None
+        merge_sq = train_sq = jnp.float32(0)
         with jax.named_scope(PHASE_SEND):
             state = self._snapshot(state, r)
             n = self.n_nodes
@@ -348,6 +359,8 @@ class All2AllGossipSimulator(GossipSimulator):
             n_drop = (sent & drop).sum()
             n_offline = (sent & ~drop & ~online[:, None]).sum()
             received_any = (live & (wt > 0)).any(axis=1)
+            if probe_mix:
+                acc_count = (live & (wt > 0)).sum(axis=1).astype(jnp.int32)
 
             def age_max(n_updates):
                 return jnp.where(live, n_updates[nbr], 0).max(axis=1)
@@ -392,6 +405,10 @@ class All2AllGossipSimulator(GossipSimulator):
             received_any = jax.ops.segment_max(
                 (live_e & (mix.edge_w > 0)).astype(jnp.int32), mix.rows, n,
                 indices_are_sorted=True) > 0
+            if probe_mix:
+                acc_count = jax.ops.segment_sum(
+                    (live_e & (mix.edge_w > 0)).astype(jnp.int32), mix.rows,
+                    n, indices_are_sorted=True)
 
             def age_max(n_updates):
                 return jax.ops.segment_max(
@@ -416,6 +433,9 @@ class All2AllGossipSimulator(GossipSimulator):
             n_drop = (sent_mask & drop).sum()
             n_offline = (sent_mask & ~drop & ~online[:, None]).sum()
             received_any = (live & (self.mixing > 0)).any(axis=1)
+            if probe_mix:
+                acc_count = (live & (self.mixing > 0)).sum(axis=1) \
+                    .astype(jnp.int32)
 
             def age_max(n_updates):
                 return jnp.where(live, n_updates[None, :], 0).max(axis=1)
@@ -460,6 +480,7 @@ class All2AllGossipSimulator(GossipSimulator):
 
         size = self._model_size(state.model.params)
         mode = self.handler.mode
+        probe_delta = probe_mix and self.probes.mixing
         if mode == CreateModelMode.UPDATE_MERGE:
             with jax.named_scope(PHASE_TRAIN):
                 keys = jax.random.split(
@@ -469,6 +490,9 @@ class All2AllGossipSimulator(GossipSimulator):
                 # Only nodes that fired (timed out) train this round
                 # (node.py:833-843) — same gate as the MERGE_UPDATE branch.
                 model = select_nodes(fires, updated, state.model)
+                if probe_delta:
+                    train_sq = sq_param_distance(model.params,
+                                                 state.model.params)
             with jax.named_scope(PHASE_RECEIVE_MERGE):
                 mixed = mix_tree(model.params)
         else:  # MERGE_UPDATE (the reference's supported path, handler.py:652-654)
@@ -479,6 +503,8 @@ class All2AllGossipSimulator(GossipSimulator):
             ages = age_max(model.n_updates)
             new_age = jnp.maximum(model.n_updates, ages)
             params = select_nodes(received_any, mixed, model.params)
+            if probe_delta:
+                merge_sq = sq_param_distance(params, model.params)
             model = ModelState(params, model.opt_state,
                                jnp.where(received_any, new_age,
                                          model.n_updates))
@@ -487,11 +513,14 @@ class All2AllGossipSimulator(GossipSimulator):
             with jax.named_scope(PHASE_TRAIN):
                 keys = jax.random.split(
                     self._round_key(base_key, r, _K_A2A_UPDATE), n)
+                pre_train = model.params
                 updated = jax.vmap(self.handler.update)(
                     model, self._local_data(), keys)
                 # Only nodes that fired (timed out) train this round
                 # (node.py:833-843).
                 model = select_nodes(fires, updated, model)
+                if probe_delta:
+                    train_sq = sq_param_distance(model.params, pre_train)
 
         state = state._replace(model=model)
         with jax.named_scope(PHASE_EVAL):
@@ -516,4 +545,40 @@ class All2AllGossipSimulator(GossipSimulator):
             "local": local,
             "global": glob,
         }
+        if self.probes is not None:
+            cfg = self.probes
+            if cfg.consensus:
+                cm, cx, cl = consensus_stats(state.model.params)
+                stats["probe_consensus_mean"] = cm
+                stats["probe_consensus_max"] = cx
+                stats["probe_consensus_per_layer"] = cl
+            if cfg.staleness:
+                # Every mixed contribution is this round's round-start
+                # snapshot: staleness is structurally zero and the whole
+                # histogram lands in bucket 0 (still summing to the
+                # accepted count bit-for-bit).
+                hist = jnp.zeros((cfg.staleness_buckets,), jnp.int32) \
+                    .at[0].set(acc_count.sum())
+                stats["probe_stale_mean"] = jnp.float32(0)
+                stats["probe_stale_max"] = jnp.int32(0)
+                stats["probe_stale_hist"] = hist
+            if cfg.mixing:
+                stats["probe_accepted_per_node"] = acc_count
+                stats["probe_merge_delta"] = jnp.sqrt(merge_sq)
+                stats["probe_train_delta"] = jnp.sqrt(train_sq)
         return state, stats
+
+    def _probe_expected_fanin(self):
+        """Broadcast mixing: every in-neighbor's send reaches a node each
+        round (sync; async nodes fire ~once per round window), thinned by
+        the per-edge drop draw and the receiver's online draw."""
+        n = self.n_nodes
+        if self.sparse_mix:
+            rows = np.asarray(self.mixing.rows)
+            w = np.asarray(self.mixing.edge_w)
+            indeg = np.bincount(rows[w > 0], minlength=n).astype(np.float64)
+        else:
+            mix = np.asarray(self.mixing)
+            adj = np.asarray(self.topology.adjacency).astype(bool)
+            indeg = (adj & (mix > 0)).sum(axis=1).astype(np.float64)
+        return indeg * (1.0 - self.drop_prob) * self.online_prob

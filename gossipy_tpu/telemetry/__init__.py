@@ -15,10 +15,21 @@ other way, so everything here is importable standalone):
 - :mod:`.sink` — process-wide structured event sink
   (:func:`emit_event` / :func:`get_sink`) that the engine's diagnostics
   (mailbox undersized, eval-memory) report to alongside their warnings.
+- :mod:`.probes` — :class:`ProbeConfig` and the traced gossip-dynamics
+  probe math (consensus distance, merge staleness, realized mixing) the
+  engines compute inside the jitted round loop when ``probes=`` is set.
 """
 
 from .causes import FAILURE_CAUSES, FailureCounts
 from .manifest import MANIFEST_SCHEMA, RunManifest, git_revision
+from .probes import (
+    PROBE_STAT_KEYS,
+    ProbeAccum,
+    ProbeConfig,
+    consensus_stats,
+    param_layer_names,
+    probe_event_row,
+)
 from .scopes import (
     PHASE_EVAL,
     PHASE_RECEIVE_MERGE,
@@ -39,4 +50,6 @@ __all__ = [
     "PHASE_REPLY", "ROUND_PHASES", "phase_scope", "phases_in_text",
     "phases_in_trace_dir",
     "TelemetryEvent", "TelemetrySink", "emit_event", "get_sink", "set_sink",
+    "ProbeConfig", "ProbeAccum", "PROBE_STAT_KEYS", "consensus_stats",
+    "param_layer_names", "probe_event_row",
 ]

@@ -105,6 +105,11 @@ def _config_snapshot(sim: Any) -> dict:
     delay = getattr(sim, "delay", None)
     if delay is not None:
         snap["delay"] = repr(delay)
+    if hasattr(sim, "probes"):
+        # The active ProbeConfig (telemetry.probes) or None: which
+        # gossip-dynamics probes this run's report/event stream carries.
+        probes = sim.probes
+        snap["probes"] = probes.to_dict() if probes is not None else None
     return snap
 
 

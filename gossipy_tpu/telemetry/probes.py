@@ -1,0 +1,260 @@
+"""Gossip-dynamics probes: in-graph consensus, staleness and mixing health.
+
+The failure counters and phase scopes (PR 1) answer the *systems*
+questions; this module carries the *learning-dynamics* quantities the
+gossip-averaging literature actually reasons about, computed INSIDE the
+jitted round program over the stacked ``[N, params]`` pytree:
+
+- **consensus distance** — per-round mean/max L2 distance of each node's
+  params from the population mean, plus a per-layer (per parameter leaf)
+  breakdown. The canonical Lyapunov quantity of gossip averaging: on a
+  connected static topology with training disabled it must decay.
+- **merge staleness** — the distribution of ``current_round − send_round``
+  over accepted model-carrying messages (mean/max plus a clamped
+  histogram). Non-zero only under message delay; the histogram's row sum
+  equals the round's accepted-message count bit-for-bit.
+- **realized mixing** — per-node accepted-merge counts (to compare against
+  the topology's expected fan-in,
+  :meth:`~gossipy_tpu.simulation.engine.GossipSimulator._expected_fanin_vector`)
+  and the per-round *merge-delta vs train-delta* norms: how far gossip
+  moved the models vs how far local SGD did.
+
+Everything here is engine-agnostic pure math (the dependency points from
+the engines to this module, like the rest of :mod:`gossipy_tpu.telemetry`):
+the jitted engine, the All2All variant, and the sequential high-fidelity
+engine all compute the same quantities through these helpers, so
+jitted-vs-sequential probe parity is testable.
+
+Probes are OPT-IN (``GossipSimulator(probes=...)``): with the default
+``probes=None`` the round program traces exactly as before — no extra
+accumulators, no extra HLO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ProbeConfig:
+    """Which gossip-dynamics probes a simulator computes per round.
+
+    - ``consensus``: mean/max L2 distance from the population-mean params
+      plus the per-layer breakdown.
+    - ``staleness``: mean/max + bucketed histogram of
+      ``current_round − send_round`` over accepted messages.
+    - ``mixing``: per-node accepted-merge counts and the merge-delta vs
+      train-delta norm decomposition.
+    - ``staleness_buckets``: histogram length; staleness values at or
+      beyond the last bucket are clamped into it.
+    """
+
+    consensus: bool = True
+    staleness: bool = True
+    mixing: bool = True
+    staleness_buckets: int = 8
+
+    def __post_init__(self):
+        if self.staleness_buckets < 2:
+            raise ValueError("staleness_buckets must be >= 2 (bucket 0 "
+                             "holds same-round merges; the last bucket "
+                             "clamps the tail)")
+
+    @classmethod
+    def coerce(cls, probes: Union[None, bool, "ProbeConfig"]
+               ) -> Optional["ProbeConfig"]:
+        """Normalize the ``probes=`` constructor argument: ``None``/``False``
+        → off (None), ``True`` → all probes at defaults, a
+        :class:`ProbeConfig` → itself (None when every probe is off)."""
+        if probes is None or probes is False:
+            return None
+        if probes is True:
+            return cls()
+        if isinstance(probes, cls):
+            if not (probes.consensus or probes.staleness or probes.mixing):
+                return None
+            return probes
+        raise TypeError(f"probes= expects None, bool or ProbeConfig; got "
+                        f"{type(probes).__name__}")
+
+    def to_dict(self) -> dict:
+        return {"consensus": self.consensus, "staleness": self.staleness,
+                "mixing": self.mixing,
+                "staleness_buckets": self.staleness_buckets}
+
+
+class ProbeAccum(NamedTuple):
+    """Traced per-round probe accumulator threaded through the deliver and
+    reply slot loops (one instance per round; summed across the phases)."""
+
+    accepted: jax.Array    # [N] int32: accepted model-carrying merges
+    stale_sum: jax.Array   # int32: sum of staleness over accepted messages
+    stale_max: jax.Array   # int32: max staleness (0 when nothing accepted)
+    stale_hist: jax.Array  # [B] int32: clamped staleness histogram
+    merge_sq: jax.Array    # f32: sum of squared merge-delta norms
+    train_sq: jax.Array    # f32: sum of squared train-delta norms
+
+    @staticmethod
+    def zeros(n: int, buckets: int) -> "ProbeAccum":
+        return ProbeAccum(
+            accepted=jnp.zeros((n,), jnp.int32),
+            stale_sum=jnp.int32(0),
+            stale_max=jnp.int32(0),
+            stale_hist=jnp.zeros((buckets,), jnp.int32),
+            merge_sq=jnp.float32(0),
+            train_sq=jnp.float32(0),
+        )
+
+    def __add__(self, other: "ProbeAccum") -> "ProbeAccum":  # type: ignore[override]
+        return ProbeAccum(
+            accepted=self.accepted + other.accepted,
+            stale_sum=self.stale_sum + other.stale_sum,
+            stale_max=jnp.maximum(self.stale_max, other.stale_max),
+            stale_hist=self.stale_hist + other.stale_hist,
+            merge_sq=self.merge_sq + other.merge_sq,
+            train_sq=self.train_sq + other.train_sq,
+        )
+
+    def record_slot(self, accepted_mask: jax.Array,
+                    staleness: jax.Array) -> "ProbeAccum":
+        """Fold one mailbox slot's accepted messages in: ``accepted_mask``
+        [N] bool, ``staleness`` [N] int32 (rounds since the payload
+        snapshot; read only where the mask holds). Each accepted message
+        adds exactly 1 to ``accepted[receiver]`` AND to exactly one
+        histogram bucket, so ``stale_hist.sum() == accepted.sum()`` holds
+        bit-for-bit by construction."""
+        acc = accepted_mask.astype(jnp.int32)
+        stale = jnp.where(accepted_mask, staleness, 0).astype(jnp.int32)
+        buckets = self.stale_hist.shape[0]
+        bucket = jnp.clip(stale, 0, buckets - 1)
+        return self._replace(
+            accepted=self.accepted + acc,
+            stale_sum=self.stale_sum + stale.sum(),
+            stale_max=jnp.maximum(self.stale_max, stale.max()),
+            stale_hist=self.stale_hist.at[bucket].add(acc),
+        )
+
+
+def sq_param_distance(a: Any, b: Any) -> jax.Array:
+    """Scalar f32: total squared L2 distance between two params pytrees
+    (computed in fp32 regardless of the leaves' storage dtype)."""
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    total = jnp.float32(0)
+    for la, lb in zip(leaves_a, leaves_b):
+        d = la.astype(jnp.float32) - lb.astype(jnp.float32)
+        total = total + (d * d).sum()
+    return total
+
+
+def consensus_stats(params: Any) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Consensus-distance statistics over stacked params (leaves ``[N, ...]``).
+
+    Returns ``(mean, max, per_layer)``:
+
+    - ``mean``/``max``: the mean/max over nodes of each node's L2 distance
+      from the population-mean parameter vector (all leaves concatenated).
+    - ``per_layer``: ``[L]`` f32, the mean over nodes of the per-LEAF L2
+      distance, one entry per parameter leaf in ``tree_leaves`` order
+      (names via :func:`param_layer_names`).
+    """
+    leaves = jax.tree_util.tree_leaves(params)
+    n = leaves[0].shape[0]
+    per_leaf_sq = []
+    for l in leaves:
+        x = l.astype(jnp.float32).reshape(n, -1)
+        d = x - x.mean(axis=0, keepdims=True)
+        per_leaf_sq.append((d * d).sum(axis=1))  # [N]
+    total_sq = sum(per_leaf_sq)
+    dist = jnp.sqrt(total_sq)
+    per_layer = jnp.stack([jnp.sqrt(s).mean() for s in per_leaf_sq])
+    return dist.mean(), dist.max(), per_layer
+
+
+def param_layer_names(params: Any) -> list[str]:
+    """Host-side leaf names ("path/to/leaf") matching
+    :func:`consensus_stats`'s ``per_layer`` ordering (``tree_leaves``
+    order)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    names = []
+    for path, _leaf in flat:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        names.append("/".join(parts) if parts else "param")
+    return names
+
+
+# Per-round probe stat keys the engines emit (and the report/event layers
+# consume). Grouped by the ProbeConfig flag that enables them.
+CONSENSUS_KEYS = ("probe_consensus_mean", "probe_consensus_max",
+                  "probe_consensus_per_layer")
+STALENESS_KEYS = ("probe_stale_mean", "probe_stale_max", "probe_stale_hist")
+MIXING_KEYS = ("probe_accepted_per_node", "probe_merge_delta",
+               "probe_train_delta")
+PROBE_STAT_KEYS = CONSENSUS_KEYS + STALENESS_KEYS + MIXING_KEYS
+
+
+def probe_stats_from_accum(cfg: ProbeConfig, pa: ProbeAccum,
+                           delta_ok: bool) -> dict:
+    """The staleness/mixing entries of a round's stats dict from the
+    accumulated :class:`ProbeAccum`. ``delta_ok`` is the static flag saying
+    the merge/train-delta decomposition is exact for this simulator's
+    receive path (base pipeline, MERGE_UPDATE); when False the delta
+    columns carry NaN rather than a wrong number."""
+    out: dict = {}
+    if cfg.staleness:
+        count = pa.stale_hist.sum()
+        out["probe_stale_mean"] = jnp.where(
+            count > 0,
+            pa.stale_sum.astype(jnp.float32) /
+            jnp.maximum(count, 1).astype(jnp.float32),
+            jnp.float32(0))
+        out["probe_stale_max"] = pa.stale_max
+        out["probe_stale_hist"] = pa.stale_hist
+    if cfg.mixing:
+        out["probe_accepted_per_node"] = pa.accepted
+        if delta_ok:
+            out["probe_merge_delta"] = jnp.sqrt(pa.merge_sq)
+            out["probe_train_delta"] = jnp.sqrt(pa.train_sq)
+        else:
+            out["probe_merge_delta"] = jnp.float32(jnp.nan)
+            out["probe_train_delta"] = jnp.float32(jnp.nan)
+    return out
+
+
+def probe_event_row(vals: dict) -> Optional[dict]:
+    """The per-round ``update_probes`` observer payload (JSON-able scalars
+    + the histogram) from one round's probe values. ``vals`` maps the
+    ``probe_*`` stat keys to host scalars/arrays for ONE round; keys for
+    disabled probes are simply absent. Returns None when ``vals`` carries
+    no probe at all."""
+    if not vals:
+        return None
+    row: dict = {}
+    if "probe_consensus_mean" in vals:
+        row["consensus_mean"] = float(vals["probe_consensus_mean"])
+        row["consensus_max"] = float(vals["probe_consensus_max"])
+    if "probe_stale_mean" in vals:
+        row["stale_mean"] = float(vals["probe_stale_mean"])
+        row["stale_max"] = int(vals["probe_stale_max"])
+        row["stale_hist"] = [int(v) for v in
+                             np.asarray(vals["probe_stale_hist"])]
+    if "probe_accepted_per_node" in vals:
+        row["accepted_total"] = int(
+            np.asarray(vals["probe_accepted_per_node"]).sum())
+        md = float(vals["probe_merge_delta"])
+        td = float(vals["probe_train_delta"])
+        row["merge_delta"] = None if np.isnan(md) else md
+        row["train_delta"] = None if np.isnan(td) else td
+    return row or None

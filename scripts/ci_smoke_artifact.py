@@ -1,0 +1,99 @@
+"""Produce the CI run's inspectable trace artifacts.
+
+Runs a tiny (seconds on one CPU core) probe-enabled gossip simulation and
+writes, into ``--out DIR``:
+
+- ``report.json`` — the full :meth:`SimulationReport.save` record (probe
+  arrays included; round-trips through ``SimulationReport.load``),
+- ``manifest.json`` — the run's :class:`RunManifest` (config, versions,
+  backend, memory budget, probes),
+- ``events.jsonl`` — the schema-v3 per-round JSONL rows.
+
+``.github/workflows/ci.yml`` uploads the directory on every run, so each
+CI run leaves a machine-readable trace of what the engine computed — not
+just a green check. The script exits non-zero on any internal
+inconsistency (a cheap end-to-end smoke on top of the artifact).
+
+Usage: ``python scripts/ci_smoke_artifact.py --out ci-artifacts``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="ci-artifacts",
+                    help="output directory (created if absent)")
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--nodes", type=int, default=16)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    import jax
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+        Topology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator, JSONLinesReceiver
+    from gossipy_tpu.simulation.report import SimulationReport
+
+    rng = np.random.default_rng(42)
+    d = 12
+    X = rng.normal(size=(20 * args.nodes, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=args.nodes, eval_on_user=False)
+    handler = SGDHandler(
+        model=LogisticRegression(d, 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8, n_classes=2,
+        input_shape=(d,), create_model_mode=CreateModelMode.MERGE_UPDATE)
+    sim = GossipSimulator(
+        handler, Topology.random_regular(args.nodes, 4, seed=42),
+        disp.stacked(), delta=20, protocol=AntiEntropyProtocol.PUSH,
+        probes=True)
+
+    key = jax.random.PRNGKey(42)
+    state = sim.init_nodes(key)
+    jsonl_path = os.path.join(args.out, "events.jsonl")
+    with JSONLinesReceiver(jsonl_path) as rx:
+        sim.add_receiver(rx)
+        state, report = sim.start(state, n_rounds=args.rounds, key=key)
+
+    report_path = report.save(os.path.join(args.out, "report.json"))
+    manifest_path = sim.run_manifest(
+        extra={"ci_smoke": True}).save(os.path.join(args.out,
+                                                    "manifest.json"))
+
+    # Consistency gates: the artifacts must actually round-trip.
+    loaded = SimulationReport.load(report_path)
+    assert np.array_equal(loaded.sent_per_round, report.sent_per_round)
+    assert np.array_equal(loaded.probe_stale_hist, report.probe_stale_hist)
+    hist_sums = report.probe_stale_hist.sum(axis=1)
+    accepted = report.probe_accepted_per_node.sum(axis=1)
+    assert np.array_equal(hist_sums, accepted), (hist_sums, accepted)
+    rows = [JSONLinesReceiver.parse_line(l) for l in open(jsonl_path)]
+    assert len(rows) == args.rounds
+    assert all(r["probes"] is not None for r in rows)
+    manifest = json.load(open(manifest_path))
+    assert manifest["config"]["probes"] is not None
+    print(f"[ci-smoke] wrote {report_path}, {manifest_path}, {jsonl_path} "
+          f"({args.rounds} rounds, {args.nodes} nodes, "
+          f"{int(accepted.sum())} accepted merges)")
+
+
+if __name__ == "__main__":
+    main()

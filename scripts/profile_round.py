@@ -45,7 +45,8 @@ if _REPO not in sys.path:
 
 
 def build_sim(cnn: bool, n_nodes: int, local_epochs: int = 1,
-              eval_every: int = 1, sampling_eval: float = 0.0):
+              eval_every: int = 1, sampling_eval: float = 0.0,
+              probes: bool = False):
     import jax.numpy as jnp
     import optax
 
@@ -88,7 +89,7 @@ def build_sim(cnn: bool, n_nodes: int, local_epochs: int = 1,
         Topology.random_regular(n_nodes, min(20, n_nodes - 1), seed=42,
                                 backend="networkx"),
         disp.stacked(), delta=100, protocol=AntiEntropyProtocol.PUSH,
-        eval_every=eval_every, sampling_eval=sampling_eval)
+        eval_every=eval_every, sampling_eval=sampling_eval, probes=probes)
 
 
 def time_config(rounds: int, **kwargs) -> float:
@@ -116,6 +117,10 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--trace", metavar="DIR", default=None,
                     help="also dump a jax.profiler trace of the full round")
+    ap.add_argument("--probes", action="store_true",
+                    help="also time the round with the gossip-dynamics "
+                         "probes on (telemetry.probes) and report their "
+                         "marginal ms/round")
     args = ap.parse_args()
 
     import _virtual_mesh
@@ -161,6 +166,10 @@ def main() -> None:
                              local_epochs=2, eval_every=10 * rounds,
                              sampling_eval=sampling)
     train = two_epochs - no_eval  # one epoch's marginal cost
+    probed = None
+    if args.probes:
+        probed = time_config(rounds, cnn=args.cnn, n_nodes=n_nodes,
+                             sampling_eval=sampling, probes=True)
 
     flops = float(cost.get("flops", float("nan")))
     bytes_ac = float(cost.get("bytes accessed", float("nan")))
@@ -176,6 +185,8 @@ def main() -> None:
             "eval": round(full - no_eval, 3),
             "train_one_epoch": round(train, 3),
             "exchange_and_overhead": round(no_eval - train, 3),
+            **({"probes_marginal": round(probed - full, 3)}
+               if probed is not None else {}),
         },
         "note": "differential attribution assumes steady state; at small "
                 "--rounds the legs carry run-to-run noise and can go "

@@ -1,0 +1,491 @@
+"""Gossip-dynamics probes: consensus, staleness, mixing diagnostics.
+
+Covers the ISSUE-3 acceptance criteria:
+
+- ``probes=None`` leaves the round program and its report untouched, and
+  enabling probes does not perturb the simulated trajectory;
+- consensus distance is monotone-decreasing on a connected static
+  topology with training disabled (pure averaging);
+- the staleness histogram's row sums equal the per-round accepted-message
+  counts bit-for-bit (fault-free AND faulty/delayed configs);
+- jitted-vs-sequential probe parity on a small topology;
+- the report field registry: every array attribute survives
+  save → load → concatenate;
+- JSONL schema v1/v2/v3 reader versioning and the ``update_probes``
+  event stream (replay and live).
+"""
+
+import json
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, ConstantDelay, \
+    CreateModelMode, Topology, UniformDelay, uniform_mixing
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, WeightedSGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import (
+    All2AllGossipSimulator,
+    GossipSimulator,
+    JSONLinesReceiver,
+    SequentialGossipSimulator,
+    SimulationEventReceiver,
+)
+from gossipy_tpu.simulation.report import (
+    PER_ROUND_FIELDS,
+    SimulationReport,
+    STATIC_FIELDS,
+)
+from gossipy_tpu.telemetry import ProbeConfig
+from gossipy_tpu.telemetry.probes import consensus_stats, param_layer_names
+
+N, D = 16, 6
+
+
+def make_data(seed=0, n_samples=320):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_samples, D)).astype(np.float32)
+    y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+    return X, y
+
+
+def make_handler(lr=0.1):
+    return SGDHandler(model=LogisticRegression(D, 2),
+                      loss=losses.cross_entropy, optimizer=optax.sgd(lr),
+                      local_epochs=1, batch_size=8, n_classes=2,
+                      input_shape=(D,),
+                      create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+
+def make_sim(cls=GossipSimulator, lr=0.1, topo=None, n=N, **kwargs):
+    X, y = make_data()
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n, eval_on_user=False)
+    topo = topo or Topology.random_regular(n, 4, seed=3)
+    return cls(make_handler(lr), topo, disp.stacked(), delta=20,
+               protocol=kwargs.pop("protocol", AntiEntropyProtocol.PUSH),
+               **kwargs)
+
+
+def run(sim, rounds=6, key=None, **init_kw):
+    key = key if key is not None else jax.random.PRNGKey(0)
+    st = sim.init_nodes(key, **init_kw)
+    return sim.start(st, n_rounds=rounds, key=key)[1]
+
+
+class TestProbeConfig:
+    def test_coerce(self):
+        assert ProbeConfig.coerce(None) is None
+        assert ProbeConfig.coerce(False) is None
+        assert ProbeConfig.coerce(True) == ProbeConfig()
+        cfg = ProbeConfig(consensus=False)
+        assert ProbeConfig.coerce(cfg) is cfg
+        assert ProbeConfig.coerce(
+            ProbeConfig(consensus=False, staleness=False,
+                        mixing=False)) is None
+        with pytest.raises(TypeError):
+            ProbeConfig.coerce("consensus")
+        with pytest.raises(ValueError):
+            ProbeConfig(staleness_buckets=1)
+
+
+class TestProbesOffIsUntouched:
+    def test_default_report_has_no_probe_fields(self):
+        rep = run(make_sim())
+        for name in PER_ROUND_FIELDS:
+            if name.startswith("probe_"):
+                assert getattr(rep, name) is None, name
+        assert rep.probe_layer_names is None
+        assert rep.to_dict()["probe_consensus_mean"] is None
+
+    def test_probes_do_not_perturb_the_trajectory(self):
+        rep_off = run(make_sim())
+        rep_on = run(make_sim(probes=True))
+        np.testing.assert_array_equal(rep_off.sent_per_round,
+                                      rep_on.sent_per_round)
+        np.testing.assert_array_equal(rep_off.failed_per_round,
+                                      rep_on.failed_per_round)
+        np.testing.assert_array_equal(np.asarray(rep_off._global),
+                                      np.asarray(rep_on._global))
+
+    def test_probes_off_hlo_identical(self):
+        """The probes=None trace is the same program as one built without
+        the argument at all (the feature's additions are all behind the
+        trace-time gate)."""
+        sim_default = make_sim()
+        sim_off = make_sim(probes=None)
+        key = jax.random.PRNGKey(0)
+        st = sim_default.init_nodes(key)
+        hlo_a = sim_default.lower_start(st, n_rounds=2, key=key).as_text()
+        hlo_b = sim_off.lower_start(st, n_rounds=2, key=key).as_text()
+        assert hlo_a == hlo_b
+
+
+class TestConsensus:
+    def test_monotone_decreasing_under_pure_averaging(self):
+        # lr=0 turns the local update into a no-op on the params: the run
+        # is pure gossip averaging, whose consensus distance must decay on
+        # a connected static topology (the acceptance-criterion sanity).
+        rep = run(make_sim(lr=0.0, probes=True), rounds=25)
+        cm = rep.probe_consensus_mean
+        assert cm[0] > 0
+        diffs = np.diff(cm)
+        assert (diffs <= 1e-6 * cm[0]).all(), cm
+        assert cm[-1] < 0.2 * cm[0]  # substantial contraction
+
+    def test_per_layer_breakdown_and_names(self):
+        rep = run(make_sim(probes=True))
+        L = rep.probe_consensus_per_layer.shape[1]
+        assert len(rep.probe_layer_names) == L
+        assert all(isinstance(s, str) for s in rep.probe_layer_names)
+        # Total distance dominates any single layer's mean distance; all
+        # finite and non-negative.
+        assert (rep.probe_consensus_per_layer >= 0).all()
+        assert np.isfinite(rep.probe_consensus_per_layer).all()
+        assert (rep.probe_consensus_max + 1e-6
+                >= rep.probe_consensus_mean).all()
+
+    def test_consensus_stats_matches_numpy_reference(self):
+        rng = np.random.default_rng(0)
+        params = {"a": rng.normal(size=(8, 3)).astype(np.float32),
+                  "b": rng.normal(size=(8, 2, 2)).astype(np.float32)}
+        cm, cx, cl = jax.jit(consensus_stats)(params)
+        flat = np.concatenate([params["a"].reshape(8, -1),
+                               params["b"].reshape(8, -1)], axis=1)
+        dist = np.linalg.norm(flat - flat.mean(0), axis=1)
+        assert np.isclose(float(cm), dist.mean(), atol=1e-5)
+        assert np.isclose(float(cx), dist.max(), atol=1e-5)
+        layer_a = np.linalg.norm(
+            params["a"].reshape(8, -1)
+            - params["a"].reshape(8, -1).mean(0), axis=1).mean()
+        assert np.isclose(float(cl[0]), layer_a, atol=1e-5)
+        assert param_layer_names(params) == ["a", "b"]
+
+
+class TestStaleness:
+    def test_hist_sums_to_accepted_count_faulty_delayed(self):
+        rep = run(make_sim(probes=True, delay=UniformDelay(0, 60),
+                           drop_prob=0.2, online_prob=0.9), rounds=12)
+        hist_sums = rep.probe_stale_hist.sum(axis=1)
+        accepted = rep.probe_accepted_per_node.sum(axis=1)
+        np.testing.assert_array_equal(hist_sums, accepted)
+        assert hist_sums.sum() > 0
+        assert (rep.probe_stale_max >= 0).all()
+        # Mean staleness is consistent with the histogram.
+        b = np.arange(rep.probe_stale_hist.shape[1])
+        with np.errstate(invalid="ignore"):
+            mean_from_hist = (rep.probe_stale_hist * b).sum(1) \
+                / np.maximum(hist_sums, 1)
+        np.testing.assert_allclose(rep.probe_stale_mean, mean_from_hist,
+                                   atol=1e-5)
+
+    def test_zero_delay_is_all_bucket_zero(self):
+        rep = run(make_sim(probes=True), rounds=5)
+        assert (rep.probe_stale_hist[:, 1:] == 0).all()
+        assert (rep.probe_stale_max == 0).all()
+        assert (rep.probe_stale_mean == 0).all()
+
+    def test_push_pull_replies_are_counted(self):
+        rep = run(make_sim(probes=True,
+                           protocol=AntiEntropyProtocol.PUSH_PULL),
+                  rounds=5)
+        accepted = rep.probe_accepted_per_node.sum(axis=1)
+        # PUSH_PULL merges both the pushed model and the reply: strictly
+        # more accepted merges than nodes after the pipeline fills.
+        assert accepted[2:].min() > N
+        np.testing.assert_array_equal(rep.probe_stale_hist.sum(axis=1),
+                                      accepted)
+
+
+class TestMixing:
+    def test_expected_fanin_matches_realized_on_fault_free_clique(self):
+        rep = run(make_sim(topo=Topology.clique(N), probes=True), rounds=8)
+        # Fault-free: every send is accepted; totals are exactly N per
+        # round and the expected-fanin vector sums to N.
+        np.testing.assert_array_equal(
+            rep.probe_accepted_per_node.sum(axis=1), np.full(8, N))
+        assert np.isclose(rep.probe_expected_fanin.sum(), N)
+        realized = rep.probe_accepted_per_node.mean(axis=0)
+        # Uniform sampling on a clique: per-node realized rate within a
+        # loose band of the expected 1.0.
+        assert abs(realized.mean() - rep.probe_expected_fanin.mean()) < 1e-9
+
+    def test_merge_and_train_deltas_finite_and_gossip_dominates_early(self):
+        rep = run(make_sim(probes=True), rounds=6)
+        assert np.isfinite(rep.probe_merge_delta).all()
+        assert np.isfinite(rep.probe_train_delta).all()
+        # Independent random inits: the first rounds' movement is merge-
+        # dominated (averaging away init disagreement beats one SGD step).
+        assert rep.probe_merge_delta[0] > rep.probe_train_delta[0]
+
+    def test_custom_receive_variant_reports_nan_deltas(self):
+        from gossipy_tpu.simulation import PassThroughGossipSimulator
+        rep = run(make_sim(cls=PassThroughGossipSimulator, probes=True),
+                  rounds=4)
+        # PassThrough overrides _receive_rows: the merge/train split is
+        # not exact, so the columns are NaN — but counts/staleness live.
+        assert np.isnan(rep.probe_merge_delta).all()
+        assert np.isnan(rep.probe_train_delta).all()
+        assert rep.probe_accepted_per_node.sum() > 0
+        # And the NaN columns survive strict-JSON serialization.
+        d = rep.to_dict()
+        assert d["probe_merge_delta"][0] is None
+
+
+class TestAll2AllProbes:
+    def _run(self, **kwargs):
+        X, y = make_data()
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=N, eval_on_user=False)
+        topo = Topology.random_regular(N, 4, seed=3)
+        handler = WeightedSGDHandler(
+            model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(D,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = All2AllGossipSimulator(handler, topo, disp.stacked(),
+                                     delta=20, mixing=uniform_mixing(topo),
+                                     **kwargs)
+        return run(sim, rounds=5)
+
+    def test_accepted_counts_and_hist(self):
+        rep = self._run(probes=True)
+        # Fault-free sync broadcast: every node receives from every
+        # in-neighbor every round.
+        np.testing.assert_array_equal(
+            rep.probe_accepted_per_node, np.full((5, N), 4))
+        np.testing.assert_array_equal(rep.probe_stale_hist[:, 0],
+                                      np.full(5, 4 * N))
+        np.testing.assert_array_equal(rep.probe_expected_fanin,
+                                      np.full(N, 4.0))
+        assert np.isfinite(rep.probe_merge_delta).all()
+        assert np.isfinite(rep.probe_consensus_mean).all()
+
+    def test_probes_do_not_perturb(self):
+        rep_off = self._run()
+        rep_on = self._run(probes=True)
+        np.testing.assert_array_equal(np.asarray(rep_off._global),
+                                      np.asarray(rep_on._global))
+
+
+class TestSequentialParity:
+    """Jitted-vs-sequential probe parity (ISSUE-3 satellite): in the
+    deterministic common-init pure-averaging regime the two engines must
+    agree — consensus within fp tolerance, staleness histograms and
+    accepted-merge counts exactly."""
+
+    def _pair(self, delay, rounds=5):
+        reps = {}
+        for cls, name in ((GossipSimulator, "jit"),
+                          (SequentialGossipSimulator, "seq")):
+            sim = make_sim(cls=cls, lr=0.0, topo=Topology.clique(N),
+                           probes=True, delay=delay)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key, local_train=False, common_init=True)
+            reps[name] = sim.start(st, n_rounds=rounds, key=key)[1]
+        return reps["jit"], reps["seq"]
+
+    def test_zero_delay_parity(self):
+        jit, seq = self._pair(ConstantDelay(0))
+        # Common init + lr 0: all nodes identical forever — consensus is
+        # exactly 0 on both engines (fp tolerance per the criterion).
+        np.testing.assert_allclose(jit.probe_consensus_mean,
+                                   seq.probe_consensus_mean, atol=1e-6)
+        np.testing.assert_allclose(jit.probe_merge_delta,
+                                   seq.probe_merge_delta, atol=1e-5)
+        # Accepted-merge counts and staleness histograms agree EXACTLY
+        # (fault-free clique: one accepted merge per node per round).
+        np.testing.assert_array_equal(
+            jit.probe_accepted_per_node.sum(axis=1),
+            seq.probe_accepted_per_node.sum(axis=1))
+        np.testing.assert_array_equal(jit.probe_stale_hist,
+                                      seq.probe_stale_hist)
+
+    def test_one_round_delay_parity(self):
+        # ConstantDelay(delta): every message lands exactly one round
+        # later on both engines — staleness is 1 for every accepted
+        # message from round 2 on, and round 1 accepts nothing.
+        jit, seq = self._pair(ConstantDelay(20))
+        np.testing.assert_array_equal(jit.probe_stale_hist,
+                                      seq.probe_stale_hist)
+        assert jit.probe_stale_hist[0].sum() == 0
+        assert (jit.probe_stale_hist[1:, 1] == N).all()
+        np.testing.assert_array_equal(
+            jit.probe_accepted_per_node.sum(axis=1),
+            seq.probe_accepted_per_node.sum(axis=1))
+        np.testing.assert_allclose(jit.probe_stale_mean,
+                                   seq.probe_stale_mean, atol=1e-6)
+
+    def test_sequential_expected_fanin_matches_engine(self):
+        jit, seq = self._pair(ConstantDelay(0), rounds=2)
+        np.testing.assert_allclose(jit.probe_expected_fanin,
+                                   seq.probe_expected_fanin, atol=1e-9)
+
+
+class TestReportRegistry:
+    def test_every_array_attribute_round_trips(self, tmp_path):
+        """The ISSUE-3 registry contract: EVERY ndarray attribute of a
+        probe-enabled report must survive save → load → concatenate — a
+        new per-round array that is not registered fails here instead of
+        being silently dropped."""
+        rep = run(make_sim(probes=True, delay=UniformDelay(0, 40)),
+                  rounds=5)
+        array_attrs = {k: v for k, v in vars(rep).items()
+                       if isinstance(v, np.ndarray)}
+        assert len(array_attrs) >= 12  # evals, counters, probes...
+        path = str(tmp_path / "report.json")
+        rep.save(path)
+        loaded = SimulationReport.load(path)
+        for k, v in array_attrs.items():
+            lv = getattr(loaded, k)
+            assert lv is not None, f"{k} dropped by save/load"
+            np.testing.assert_allclose(
+                np.asarray(lv, np.float64), np.asarray(v, np.float64),
+                atol=1e-6, equal_nan=True, err_msg=k)
+        cat = SimulationReport.concatenate([loaded, loaded])
+        for k, v in array_attrs.items():
+            if k in ("sent_per_round", "failed_per_round") \
+                    or k in PER_ROUND_FIELDS or k in ("_local", "_global"):
+                cv = getattr(cat, k)
+                assert cv is not None, f"{k} dropped by concatenate"
+                assert cv.shape[0] == 2 * v.shape[0], k
+        # Static fields carry over from the first segment.
+        assert cat.probe_layer_names == rep.probe_layer_names
+        np.testing.assert_array_equal(cat.probe_expected_fanin,
+                                      rep.probe_expected_fanin)
+        # failed_per_cause (dict-valued) concatenates too.
+        for c, arr in rep.failed_per_cause.items():
+            assert cat.failed_per_cause[c].shape[0] == 2 * arr.shape[0]
+
+    def test_unknown_extra_field_raises(self):
+        with pytest.raises(TypeError, match="unknown report field"):
+            SimulationReport(metric_names=["accuracy"], local_evals=None,
+                             global_evals=None, sent=np.zeros(1),
+                             failed=np.zeros(1), total_size=0,
+                             probe_new_thing=np.zeros(1))
+
+    def test_registry_names_are_disjoint(self):
+        assert not set(PER_ROUND_FIELDS) & set(STATIC_FIELDS)
+
+
+class ProbeRecorder(SimulationEventReceiver):
+    def __init__(self, live=False):
+        self.live = live
+        self.rows = []
+
+    def update_probes(self, round, probes):
+        self.rows.append((round, probes))
+
+
+class TestEventsAndJSONL:
+    def test_update_probes_replay_and_live_agree(self):
+        X, y = make_data()
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=N, eval_on_user=False)
+
+        def go(live):
+            sim = GossipSimulator(make_handler(), Topology.clique(N),
+                                  disp.stacked(), delta=20, probes=True)
+            rec = ProbeRecorder(live=live)
+            sim.add_receiver(rec)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=3, key=key)
+            return rec.rows
+
+        replay, live = go(False), go(True)
+        assert [r for r, _ in replay] == [1, 2, 3]
+        assert replay == live
+        for _, row in replay:
+            assert set(row) >= {"consensus_mean", "stale_hist",
+                                "accepted_total", "merge_delta"}
+            assert sum(row["stale_hist"]) == row["accepted_total"]
+
+    def test_jsonl_v3_rows_and_version_tolerant_reader(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sim = make_sim(probes=True)
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rx)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=3, key=key)
+        rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
+        assert all(r["schema"] == 3 for r in rows)
+        assert all(r["probes"] is not None for r in rows)
+        assert all(sum(r["probes"]["stale_hist"])
+                   == r["probes"]["accepted_total"] for r in rows)
+        # v1 and v2 lines (as historic writers produced them) normalize to
+        # the v3 shape: predating fields come back None, values intact.
+        v1 = json.dumps({"schema": 1, "round": 7, "sent": 5, "failed": 1,
+                         "size": 10, "local": None, "global": None})
+        v2 = json.dumps({"schema": 2, "round": 8, "sent": 5, "failed": 1,
+                         "failed_by_cause": {"drop": 1, "offline": 0,
+                                             "overflow": 0},
+                         "size": 10, "local": None, "global": None})
+        r1, r2 = JSONLinesReceiver.parse_line(v1), \
+            JSONLinesReceiver.parse_line(v2)
+        assert r1["failed_by_cause"] is None and r1["probes"] is None
+        assert r1["round"] == 7 and r1["sent"] == 5
+        assert r2["failed_by_cause"]["drop"] == 1 and r2["probes"] is None
+        # A hypothetical future line with unknown fields passes through.
+        v9 = json.dumps({"schema": 9, "round": 1, "sent": 0, "failed": 0,
+                         "failed_by_cause": None, "probes": None,
+                         "size": 0, "local": None, "global": None,
+                         "widget": 42})
+        assert JSONLinesReceiver.parse_line(v9)["widget"] == 42
+
+    def test_jsonl_without_probes_has_null_probes(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sim = make_sim()
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rx)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=2, key=key)
+        rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
+        assert all(r["probes"] is None for r in rows)
+
+    def test_probes_summary_lands_in_telemetry_sink(self):
+        from gossipy_tpu.telemetry import TelemetrySink, get_sink, set_sink
+        prev = set_sink(TelemetrySink())
+        try:
+            run(make_sim(probes=True), rounds=3)
+            evs = get_sink().events(kind="probes_summary")
+            assert len(evs) == 1
+            assert evs[0].data["accepted_total"] > 0
+            assert "consensus_last" in evs[0].data
+        finally:
+            set_sink(prev)
+
+    def test_manifest_records_probe_config(self):
+        sim_on = make_sim(probes=ProbeConfig(staleness_buckets=4))
+        sim_off = make_sim()
+        assert sim_on.run_manifest().to_dict()["config"]["probes"][
+            "staleness_buckets"] == 4
+        assert sim_off.run_manifest().to_dict()["config"]["probes"] is None
+
+
+class TestRepetitionsAndSegments:
+    def test_run_repetitions_carries_probes_per_seed(self):
+        sim = make_sim(probes=True)
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        _, reports = sim.run_repetitions(4, keys)
+        assert len(reports) == 3
+        for rep in reports:
+            assert rep.probe_consensus_mean.shape == (4,)
+            np.testing.assert_array_equal(
+                rep.probe_stale_hist.sum(axis=1),
+                rep.probe_accepted_per_node.sum(axis=1))
+
+    def test_segmented_start_concatenates_probe_arrays(self):
+        sim = make_sim(probes=True)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        st, r1 = sim.start(st, n_rounds=3, key=key)
+        st, r2 = sim.start(st, n_rounds=2, key=key)
+        cat = SimulationReport.concatenate([r1, r2])
+        assert cat.probe_consensus_mean.shape == (5,)
+        assert cat.probe_stale_hist.shape[0] == 5
