@@ -61,6 +61,10 @@ WIRE_INFO: dict = {}
 # Probes-on vs probes-off throughput stamp (north-star mode): the overhead
 # of the opt-in gossip-dynamics probes, itself observed. Merged into raw.
 PROBE_INFO: dict = {}
+# Sentinels-on vs sentinels-off throughput stamp (north-star mode): the
+# overhead of the opt-in numerics sentinels (telemetry.health; ISSUE-4
+# acceptance target < 5% on this config). Merged into raw.
+SENTINEL_INFO: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -138,7 +142,8 @@ def make_data():
     return X, y
 
 
-def build_sim(X, y, fused: bool = False, probes: bool = False):
+def build_sim(X, y, fused: bool = False, probes: bool = False,
+              sentinels: bool = False):
     """The bench configuration (shared by the throughput and to-accuracy
     modes): 100 nodes, LogReg SGD, MERGE_UPDATE, PUSH over a 20-regular
     graph, per-round global eval."""
@@ -164,16 +169,17 @@ def build_sim(X, y, fused: bool = False, probes: bool = False):
                            protocol=AntiEntropyProtocol.PUSH,
                            fused_merge=fused,
                            history_dtype=HISTORY_DTYPE,
-                           probes=probes)
+                           probes=probes,
+                           sentinels=sentinels)
 
 
 def bench_ours(X, y) -> float:
     import jax
 
-    def run(fused: bool, probes: bool = False) \
+    def run(fused: bool, probes: bool = False, sentinels: bool = False) \
             -> tuple[float, float, object, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
-        sim = build_sim(X, y, fused, probes=probes)
+        sim = build_sim(X, y, fused, probes=probes, sentinels=sentinels)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
         # Warmup: trigger compilation of the scan (donate_state=False: the
@@ -224,6 +230,25 @@ def bench_ours(X, y) -> float:
               file=sys.stderr)
     except Exception as e:  # the A/B must not kill the main measurement
         print(f"[bench] probes A/B failed ({e!r})", file=sys.stderr)
+    try:
+        # Sentinel overhead, measured the same way: the plain config with
+        # the numerics sentinels on (non-finite counts + divergence EMA +
+        # saturation watermarks), A/B'd against the sentinels-off run
+        # above (which IS the default path — sentinels=None compiles the
+        # identical program). ISSUE-4 acceptance: < 5% on this config.
+        elapsed_s, _, _, _ = run(False, sentinels=True)
+        SENTINEL_INFO.update({
+            "sentinels_off_rounds_per_sec": round(n_rounds / elapsed, 2),
+            "sentinels_on_rounds_per_sec": round(n_rounds / elapsed_s, 2),
+            "sentinels_overhead_frac": round(
+                max(0.0, 1.0 - elapsed / elapsed_s), 4),
+        })
+        print(f"[bench] sentinels on: {n_rounds} rounds in {elapsed_s:.2f}s "
+              f"({n_rounds / elapsed_s:.1f} r/s; overhead "
+              f"{SENTINEL_INFO['sentinels_overhead_frac']:.1%} vs "
+              f"sentinels off)", file=sys.stderr)
+    except Exception as e:  # the A/B must not kill the main measurement
+        print(f"[bench] sentinels A/B failed ({e!r})", file=sys.stderr)
     stamp_wire_traffic(sim, report, n_rounds)
     emit_manifest(sim, f"north-star/{label}")
     return n_rounds / elapsed
@@ -1383,6 +1408,7 @@ def main():
         "raw": {
             **WIRE_INFO,
             **PROBE_INFO,
+            **SENTINEL_INFO,
             "ours_rounds_per_sec": round(ours, 2),
             "ours_rounds_measured": (BENCH_ROUNDS_DEGRADED if DEGRADED
                                      else BENCH_ROUNDS),

@@ -58,6 +58,16 @@ def add_probes_flag(p):
     return p
 
 
+def add_sentinels_flag(p):
+    """Only for scripts that pass it through to their simulator."""
+    p.add_argument("--sentinels", action="store_true",
+                   help="compute the in-graph numerics sentinels "
+                        "(non-finite counts, divergence flags, saturation "
+                        "watermarks — docs/observability.md) and print "
+                        "their summary")
+    return p
+
+
 def finish(report, args, local: bool = False, label: str = "final"):
     """Print a one-line JSON summary + optionally save the plot.
 
@@ -100,6 +110,21 @@ def finish(report, args, local: bool = False, label: str = "final"):
                 probes["merge_delta_last"] = round(float(md[-1]), 6)
                 probes["train_delta_last"] = round(float(td[-1]), 6)
         summary["probes"] = probes
+    trips = getattr(reports[0], "health_trip", None)
+    if trips is not None:
+        # Numerics-sentinel summary (runs started with sentinels=).
+        import numpy as _np
+        health = {"trips": int(_np.sum(trips))}
+        nf = getattr(reports[0], "health_nonfinite_params", None)
+        if nf is not None:
+            health["nonfinite_params"] = int(_np.sum(nf))
+        dv = getattr(reports[0], "health_diverged_per_node", None)
+        if dv is not None:
+            health["diverged"] = int(_np.sum(dv))
+        hwm = getattr(reports[0], "health_delta_hwm", None)
+        if hwm is not None and len(hwm) and _np.isfinite(hwm[-1]):
+            health["delta_hwm"] = round(float(hwm[-1]), 6)
+        summary["health"] = health
     print(json.dumps(summary))
     if args.plot:
         from gossipy_tpu.utils import plot_evaluation

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import optax
 
-from _common import add_probes_flag, make_parser, finish
+from _common import add_probes_flag, add_sentinels_flag, make_parser, finish
 
 from gossipy_tpu import set_seed
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology, \
@@ -29,6 +29,7 @@ def main():
     parser.add_argument("--mixing", choices=["uniform", "metropolis"],
                         default="uniform")
     add_probes_flag(parser)
+    add_sentinels_flag(parser)
     args = parser.parse_args()
     key = set_seed(args.seed)
 
@@ -51,7 +52,8 @@ def main():
         handler, topology, dispatcher.stacked(),
         mixing=mix(topology),
         delta=100, protocol=AntiEntropyProtocol.PUSH,
-        sampling_eval=0.1, sync=False, probes=args.probes)
+        sampling_eval=0.1, sync=False, probes=args.probes,
+        sentinels=args.sentinels)
 
     state = simulator.init_nodes(key)
     state, report = simulator.start(state, n_rounds=args.rounds, key=key)

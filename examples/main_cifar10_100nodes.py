@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from _common import add_probes_flag, make_parser, finish
+from _common import add_probes_flag, add_sentinels_flag, make_parser, finish
 
 from gossipy_tpu import set_seed
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
@@ -53,6 +53,7 @@ def main():
                              "gather traffic 2-4x (quantize-on-snapshot, "
                              "dequantize-on-gather; merge math stays fp32)")
     add_probes_flag(parser)
+    add_sentinels_flag(parser)
     args = parser.parse_args()
     key = set_seed(args.seed)
 
@@ -96,7 +97,7 @@ def main():
         delta=100, protocol=AntiEntropyProtocol.PUSH,
         sampling_eval=0.1, sync=True, eval_every=args.eval_every,
         fused_merge=args.fused, history_dtype=args.history_dtype,
-        probes=args.probes)
+        probes=args.probes, sentinels=args.sentinels)
     budget = simulator.memory_budget()
     print(f"[cifar10-100nodes] history ring ({args.history_dtype}): "
           f"{budget['history_ring_bytes'] / 2**20:.1f} MB "

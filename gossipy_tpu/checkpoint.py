@@ -52,17 +52,42 @@ def _checkpointer():
 
 
 def save_checkpoint(path: str, state: Any, key: Optional[jax.Array] = None,
-                    force: bool = True) -> str:
+                    force: bool = True,
+                    meta: Optional[dict] = None) -> str:
     """Save a SimState (or any pytree) + optional PRNG key to ``path``.
+
+    ``meta`` (JSON-able dict) is written as a ``<path>.meta.json``
+    SIDECAR next to the checkpoint directory — host-readable context
+    (round index, why the snapshot was taken) that a post-mortem can
+    read without paying an orbax restore; the flight recorder
+    (:mod:`gossipy_tpu.telemetry.health`) stamps its bundles through
+    this. The sidecar lives outside the orbax directory so the restore
+    path never sees an unexpected file.
 
     Returns the absolute checkpoint path.
     """
+    import json
     path = os.path.abspath(path)
     payload = {"state": state}
     if key is not None:
         payload["key"] = key
     _checkpointer().save(path, payload, force=force)
+    if meta is not None:
+        with open(path + ".meta.json", "w") as fh:
+            json.dump(meta, fh, indent=2)
+            fh.write("\n")
     return path
+
+
+def load_checkpoint_meta(path: str) -> Optional[dict]:
+    """Read the ``meta`` sidecar written by :func:`save_checkpoint`, or
+    None when the checkpoint has no sidecar."""
+    import json
+    sidecar = os.path.abspath(path) + ".meta.json"
+    if not os.path.exists(sidecar):
+        return None
+    with open(sidecar) as fh:
+        return json.load(fh)
 
 
 def restore_checkpoint(path: str, template_state: Any,

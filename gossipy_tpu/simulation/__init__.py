@@ -2,6 +2,7 @@
 
 from .engine import GossipSimulator, Mailbox, SimState
 from .events import (
+    CallbackReceiver,
     JSONLinesReceiver,
     ProgressReceiver,
     SimulationEventReceiver,
@@ -30,6 +31,6 @@ __all__ = [
     "SamplingGossipSimulator", "PartitioningGossipSimulator",
     "PENSGossipSimulator",
     "SimulationEventReceiver", "SimulationEventSender", "ProgressReceiver",
-    "JSONLinesReceiver",
+    "JSONLinesReceiver", "CallbackReceiver",
     "SequentialGossipSimulator", "SeqState", "MessageRecord",
 ]

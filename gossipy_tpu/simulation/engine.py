@@ -61,6 +61,14 @@ from ..telemetry import (
     ProbeConfig,
     emit_event,
 )
+from ..telemetry.health import (
+    HEALTH_STAT_KEYS,
+    HealthCarry,
+    SentinelConfig,
+    health_event_row,
+    health_round_stats,
+    nonfinite_total,
+)
 from ..telemetry.probes import (
     PROBE_STAT_KEYS,
     consensus_stats,
@@ -361,6 +369,25 @@ class GossipSimulator(SimulationEventSender):
         MERGE_UPDATE (recomputing the handler's merge as a pure probe);
         variants with custom receive behavior report NaN deltas while the
         other probes stay live.
+    sentinels : SentinelConfig | bool | None
+        Opt-in numerics sentinels computed INSIDE the jitted round
+        program (:mod:`gossipy_tpu.telemetry.health`): per-leaf
+        non-finite counts on params / the round's param delta / the
+        evaluated metric rows (plus the first mailbox slot whose
+        delivery introduced a non-finite value), per-node divergence
+        flags (param norm exceeding a configurable multiple of its own
+        EMA, tracked across rounds in the scan carry), the round-delta
+        norm with its running high-water mark, and the run-level
+        mailbox-saturation watermark — summarized in a per-round
+        ``health_trip`` flag. ``None`` (default) traces the exact same
+        program as before the feature; ``True`` enables all sentinels; a
+        :class:`~gossipy_tpu.telemetry.SentinelConfig` picks a subset.
+        Health arrays land in the report (``health_*``), stream through
+        the ``update_health`` observer event (live runs also emit a
+        ``sentinel_trip`` telemetry event from inside the program the
+        moment a round trips) and are stamped into the run manifest.
+        Pair with :class:`~gossipy_tpu.telemetry.FlightRecorder` to
+        capture a deterministically replayable repro bundle on anomaly.
     """
 
     # Out-of-tree subclasses that override ``_decode_extra`` or
@@ -391,7 +418,8 @@ class GossipSimulator(SimulationEventSender):
                  compact_deliver: Optional[bool] = None,
                  max_fires_per_round: Optional[int] = None,
                  history_dtype: str = "float32",
-                 probes: Union[None, bool, ProbeConfig] = None):
+                 probes: Union[None, bool, ProbeConfig] = None,
+                 sentinels: Union[None, bool, SentinelConfig] = None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -518,6 +546,20 @@ class GossipSimulator(SimulationEventSender):
         # variants (PassThrough's accept draw, CacheNeigh's parking, PENS
         # phase 1) report NaN deltas instead of a wrong number.
         self.probes: Optional[ProbeConfig] = ProbeConfig.coerce(probes)
+        # Numerics sentinels: None = strictly no sentinel code in the
+        # trace (same discipline as probes — the default round program is
+        # byte-identical to the pre-feature one). The per-round vitals
+        # are computed by the scan body AFTER ``_round`` (so every
+        # variant's round program is covered without re-implementation);
+        # the cross-round EMA/high-water state rides the scan carry.
+        self.sentinels: Optional[SentinelConfig] = \
+            SentinelConfig.coerce(sentinels)
+        # Cross-run sentinel state: the divergence EMA and the high-water
+        # marks PERSIST across consecutive start() calls on this
+        # simulator (chunked drivers — CheckpointManager, FlightRecorder
+        # — must not re-seed the EMA at every chunk boundary, or a jump
+        # on a chunk's first round is invisible). init_nodes resets it.
+        self._health_carry: Optional[HealthCarry] = None
         self._probe_delta_ok = (
             self.probes is not None and self.probes.mixing
             and self.handler.mode == CreateModelMode.MERGE_UPDATE
@@ -897,6 +939,7 @@ class GossipSimulator(SimulationEventSender):
         without it. The local pre-training pass still diversifies nodes.
         """
         n = self.n_nodes
+        self._health_carry = None  # fresh population, fresh sentinel EMA
         k_init, k_phase, k_up = jax.random.split(key, 3)
         if common_init:
             one = self.handler.init(k_init)
@@ -1213,6 +1256,50 @@ class GossipSimulator(SimulationEventSender):
         return (took_compact.astype(jnp.int32),
                 (occupied_slot & ~took_compact).astype(jnp.int32))
 
+    # -- sentinels (opt-in; see telemetry.health) ---------------------------
+
+    def _health_slots_on(self) -> bool:
+        """Static: whether the deliver slot loop carries the sentinel
+        first-bad-slot accumulator (non-finite sentinel enabled)."""
+        return self.sentinels is not None and self.sentinels.nonfinite
+
+    def _health_zero_carry(self) -> HealthCarry:
+        return HealthCarry.zeros(self.n_nodes)
+
+    def _health_round(self, hc: HealthCarry, pre_params,
+                      state: SimState, stats: dict
+                      ) -> tuple[HealthCarry, dict]:
+        """One round's sentinel vitals (traced). Runs in the scan body
+        AFTER ``_round``, over the round-start params kept from before
+        the call — so every engine/variant round program is covered by
+        the same code path."""
+        return health_round_stats(
+            self.sentinels, hc, pre_params, state.model.params,
+            stats.get("local"), stats.get("global"),
+            mailbox_hwm=stats.get("mailbox_hwm"))
+
+    def _emit_trip_live(self, state: SimState, stats: dict) -> None:
+        """Host notification the moment a sentinel trips (live runs): an
+        unordered ``io_callback`` behind a ``lax.cond``, so healthy
+        rounds pay nothing and a tripped round lands a ``sentinel_trip``
+        telemetry event while the program is still running — a wedged
+        run's last words include the verdict."""
+        nf = stats.get("health_nonfinite_params")
+        nf_total = nf.sum() if nf is not None else jnp.int32(0)
+
+        def cb(rnd, nft):
+            emit_event("sentinel_trip", {
+                "round": int(rnd), "nonfinite_params": int(nft),
+                "simulator": type(self).__name__})
+
+        def fire():
+            jax.experimental.io_callback(cb, None, state.round, nf_total,
+                                         ordered=False)
+            return jnp.int32(0)
+
+        jax.lax.cond(stats["health_trip"] > 0, fire,
+                     lambda: jnp.int32(0))
+
     # -- probes (opt-in; see telemetry.probes) ------------------------------
 
     def _probe_slots_on(self) -> bool:
@@ -1311,15 +1398,14 @@ class GossipSimulator(SimulationEventSender):
         # derivation, dynamic slot reads, and the _post_receive_slot hook —
         # subclass hooks must treat k as an array, not a Python int.
         probes_on = self._probe_slots_on()
+        health_on = self._health_slots_on()
 
         def slot_body(k, carry):
-            if probes_on:
-                state, fails, n_sent_replies, reply_size_total, \
-                    n_compact, n_wide, pa = carry
-            else:
-                state, fails, n_sent_replies, reply_size_total, \
-                    n_compact, n_wide = carry
-                pa = None
+            state, fails, n_sent_replies, reply_size_total, \
+                n_compact, n_wide = carry[:6]
+            tail = list(carry[6:])
+            pa = tail.pop(0) if probes_on else None
+            first_bad = tail.pop(0) if health_on else None
             sender = jnp.take(state.mailbox.sender[b], k, axis=1)
             sr = jnp.take(state.mailbox.send_round[b], k, axis=1)
             ty = jnp.take(state.mailbox.msg_type[b], k, axis=1)
@@ -1352,6 +1438,21 @@ class GossipSimulator(SimulationEventSender):
             if probes_on:
                 pa = self._probe_slot_update(pa, state, pre_model, sr,
                                              sender, extra, apply_mask, r)
+            if health_on:
+                # Sentinel accumulator: the first slot whose delivery left
+                # a non-finite value in the model params (-1 = clean), so
+                # a post-mortem can name the offending mailbox slot. The
+                # isfinite reduction runs behind a cond — only for slots
+                # that actually delivered something while no earlier slot
+                # has tripped — so the common all-clean round pays it for
+                # ~1 occupied slot, not all K.
+                def _scan_bad(fb):
+                    bad = nonfinite_total(state.model.params) > 0
+                    return jnp.where(bad, jnp.asarray(k, jnp.int32), fb)
+
+                first_bad = jax.lax.cond(
+                    apply_mask.any() & (first_bad < 0),
+                    _scan_bad, lambda fb: fb, first_bad)
 
             if self._replies_possible():
                 wants_reply = (ty == MessageType.PULL) | (ty == MessageType.PUSH_PULL)
@@ -1382,12 +1483,18 @@ class GossipSimulator(SimulationEventSender):
                                             extra, base_key, r, k)
             out = (state, fails, n_sent_replies, reply_size_total,
                    n_compact, n_wide)
-            return out + ((pa,) if probes_on else ())
+            if probes_on:
+                out = out + (pa,)
+            if health_on:
+                out = out + (first_bad,)
+            return out
 
         init = (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0),
                 jnp.int32(0), jnp.int32(0))
         if probes_on:
             init = init + (self._probe_zero_accum(),)
+        if health_on:
+            init = init + (jnp.int32(-1),)
         carry = jax.lax.fori_loop(0, self.K, slot_body, init)
         state, fails, n_sent_replies, reply_size_total, n_compact, n_wide = \
             carry[:6]
@@ -1398,6 +1505,8 @@ class GossipSimulator(SimulationEventSender):
                 "wide_slots": n_wide}
         if probes_on:
             diag["probe_accum"] = carry[6]
+        if health_on:
+            diag["first_bad_slot"] = carry[6 + (1 if probes_on else 0)]
         return state, n_sent_replies + ex_sent, fails + ex_fails, \
             reply_size_total + ex_size, diag
 
@@ -1610,6 +1719,11 @@ class GossipSimulator(SimulationEventSender):
             if self._probe_slots_on():
                 pa = diag["probe_accum"] + reply_diag["probe_accum"]
             stats.update(self._probe_round_stats(state, pa))
+        if self._health_slots_on():
+            # The round-level vitals are appended by the scan body
+            # (_health_round); the slot-resolved accumulator can only
+            # come from inside the deliver loop, so it rides here.
+            stats["health_first_bad_slot"] = diag["first_bad_slot"]
         return state, stats
 
     # -- public API ---------------------------------------------------------
@@ -1621,19 +1735,24 @@ class GossipSimulator(SimulationEventSender):
         ``_live_round_times`` — the basis for the report's per-round timing
         and rounds/sec EMA when the run is live."""
         names = self._metric_keys()
-        # Probe values ride the same ordered callback (fixed key order so
-        # the host side can rebuild the dict from positional operands).
+        # Probe and health values ride the same ordered callback (fixed
+        # key order so the host side can rebuild the dicts from
+        # positional operands).
         probe_keys = [k for k in PROBE_STAT_KEYS if k in stats]
+        health_keys = [k for k in HEALTH_STAT_KEYS if k in stats]
 
         def cb(rnd, sent, failed, drop, offline, overflow, size, local,
-               glob, *probe_vals):
+               glob, *extra_vals):
             import time as _time
             times = getattr(self, "_live_round_times", None)
             if times is not None:
                 times.append(_time.perf_counter())
             causes = {"drop": int(drop), "offline": int(offline),
                       "overflow": int(overflow)}
-            probes = probe_event_row(dict(zip(probe_keys, probe_vals)))
+            probes = probe_event_row(
+                dict(zip(probe_keys, extra_vals[:len(probe_keys)])))
+            health = health_event_row(
+                dict(zip(health_keys, extra_vals[len(probe_keys):])))
 
             def row(vals):
                 if np.all(np.isnan(vals)):
@@ -1641,13 +1760,14 @@ class GossipSimulator(SimulationEventSender):
                 return {k: float(v) for k, v in zip(names, vals)}
             self._notify_round(int(rnd), int(sent), int(failed), int(size),
                                row(local), row(glob), live_only=True,
-                               causes=causes, probes=probes)
+                               causes=causes, probes=probes, health=health)
 
         jax.experimental.io_callback(
             cb, None, state.round, stats["sent"], stats["failed"],
             stats["failed_drop"], stats["failed_offline"],
             stats["failed_overflow"], stats["size"], stats["local"],
-            stats["global"], *[stats[k] for k in probe_keys], ordered=True)
+            stats["global"], *[stats[k] for k in probe_keys],
+            *[stats[k] for k in health_keys], ordered=True)
 
     def _cache_salt(self):
         """Extra jit-cache key component for variants whose trace depends on
@@ -1701,20 +1821,52 @@ class GossipSimulator(SimulationEventSender):
         non-addressable shards. Inside the trace ``self.data`` is rebound to
         the traced values so every helper reads the argument.
         """
-        def run(state, key, data):
-            saved = self.data
-            self.data = data
-            try:
-                last = state.round + n_rounds - 1
+        sentinels_on = self.sentinels is not None
 
-                def body(st, _):
-                    st, stats = self._round(st, key, last)
-                    if live:
-                        self._emit_live(st, stats)
-                    return st, stats
-                return jax.lax.scan(body, state, None, length=n_rounds)
-            finally:
-                self.data = saved
+        def scan_rounds(state, key, hc):
+            last = state.round + n_rounds - 1
+
+            def body(carry, _):
+                if sentinels_on:
+                    st, c = carry
+                    pre_params = st.model.params
+                else:
+                    st, c = carry, None
+                st, stats = self._round(st, key, last)
+                if sentinels_on:
+                    c, hstats = self._health_round(c, pre_params, st,
+                                                   stats)
+                    stats.update(hstats)
+                if live:
+                    self._emit_live(st, stats)
+                    if sentinels_on:
+                        self._emit_trip_live(st, stats)
+                return ((st, c) if sentinels_on else st), stats
+
+            init = (state, hc) if sentinels_on else state
+            final, stats = jax.lax.scan(body, init, None, length=n_rounds)
+            return final, stats
+
+        if sentinels_on:
+            # The health carry crosses the jit boundary: consecutive
+            # start() calls continue the divergence EMA instead of
+            # re-seeding it every segment (see __init__).
+            def run(state, key, data, hc):
+                saved = self.data
+                self.data = data
+                try:
+                    (state, hc), stats = scan_rounds(state, key, hc)
+                    return state, hc, stats
+                finally:
+                    self.data = saved
+        else:
+            def run(state, key, data):
+                saved = self.data
+                self.data = data
+                try:
+                    return scan_rounds(state, key, None)
+                finally:
+                    self.data = saved
         return run
 
     def lower_start(self, state: SimState, n_rounds: int = 100,
@@ -1729,8 +1881,10 @@ class GossipSimulator(SimulationEventSender):
         """
         if key is None:
             key = jax.random.PRNGKey(42)
-        return jax.jit(self._make_run(n_rounds, live=False)).lower(
-            state, key, self.data)
+        args = (state, key, self.data)
+        if self.sentinels is not None:
+            args = args + (self._health_zero_carry(),)
+        return jax.jit(self._make_run(n_rounds, live=False)).lower(*args)
 
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None,
@@ -1779,13 +1933,21 @@ class GossipSimulator(SimulationEventSender):
         # boundary and skip timing rather than invent one.
         self._live_round_times: Optional[list] = [] if live else None
         t_run0 = _time.perf_counter()
+        args = (state, key, self.data)
+        if self.sentinels is not None:
+            hc_in = (self._health_carry if self._health_carry is not None
+                     else self._health_zero_carry())
+            args = args + (hc_in,)
         if profile_dir is not None:
             with jax.profiler.trace(profile_dir):
-                state, stats = self._jit_cache[cache_k](state, key,
-                                                        self.data)
-                jax.block_until_ready(state.model.params)
+                out = self._jit_cache[cache_k](*args)
+                jax.block_until_ready(out[0].model.params)
         else:
-            state, stats = self._jit_cache[cache_k](state, key, self.data)
+            out = self._jit_cache[cache_k](*args)
+        if self.sentinels is not None:
+            state, self._health_carry, stats = out
+        else:
+            state, stats = out
         if cold:
             # Wall time of the cold dispatch: tracing + XLA compilation
             # (execution is async-dispatched and largely excluded, except
@@ -1813,12 +1975,17 @@ class GossipSimulator(SimulationEventSender):
                                "offline": np.asarray(stats["failed_offline"]),
                                "overflow": np.asarray(stats["failed_overflow"])}
         extras = {k: opt(k) for k in PROBE_STAT_KEYS if k in stats}
+        extras.update({k: opt(k) for k in HEALTH_STAT_KEYS if k in stats})
         if self.probes is not None:
             if self.probes.consensus:
                 extras["probe_layer_names"] = self._probe_layer_names()
             if self.probes.mixing:
                 extras["probe_expected_fanin"] = np.asarray(
                     self._probe_expected_fanin(), np.float64)
+        if self.sentinels is not None and self.sentinels.nonfinite:
+            # Same shape-only leaf naming as the probes' per-layer
+            # breakdown: names the columns of the non-finite counts.
+            extras["health_layer_names"] = self._probe_layer_names()
         report = SimulationReport(
             metric_names=self._metric_keys(),
             local_evals=np.asarray(stats["local"]) if self.has_local_test else None,
@@ -1890,11 +2057,25 @@ class GossipSimulator(SimulationEventSender):
                 st = self.init_nodes(k_init, local_train=local_train,
                                      common_init=common_init)
                 last = st.round + n_rounds - 1
+                sentinels_on = self.sentinels is not None
 
-                def body(s, _):
-                    return self._round(s, k_run, last)
+                def body(carry, _):
+                    if sentinels_on:
+                        s, hc = carry
+                        pre_params = s.model.params
+                        s, stats = self._round(s, k_run, last)
+                        hc, hstats = self._health_round(hc, pre_params,
+                                                        s, stats)
+                        stats.update(hstats)
+                        return (s, hc), stats
+                    s, stats = self._round(carry, k_run, last)
+                    return s, stats
 
-                return jax.lax.scan(body, st, None, length=n_rounds)
+                init = ((st, self._health_zero_carry())
+                        if sentinels_on else st)
+                final, stats = jax.lax.scan(body, init, None,
+                                            length=n_rounds)
+                return (final[0] if sentinels_on else final), stats
             self._jit_cache[cache_k] = jax.jit(jax.vmap(one))
 
         # Under the seed vmap the compact/wide dispatch predicate is

@@ -73,6 +73,17 @@ class SimulationEventReceiver:
         depending on which probes are on. Fired after
         ``update_failure_causes``, live and replayed alike."""
 
+    def update_health(self, round: int, health: dict) -> None:
+        """Per-round numerics-sentinel vitals (fired only by runs with
+        ``sentinels=`` enabled; see :mod:`gossipy_tpu.telemetry.health`).
+        ``health`` carries the JSON-able per-round summary — subsets of
+        ``nonfinite_params``/``nonfinite_delta``/``nonfinite_metrics``,
+        ``first_bad_slot``, ``mix_nonfinite``, ``diverged``/
+        ``param_norm_max``, ``delta_norm``/``delta_hwm``,
+        ``mailbox_hwm_run`` and ``trip`` — depending on the active
+        :class:`~gossipy_tpu.telemetry.SentinelConfig`. Fired after
+        ``update_probes``, live and replayed alike."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""
@@ -111,7 +122,8 @@ class SimulationEventSender:
                       live_only: bool = False,
                       include_live: bool = False,
                       causes: Optional[dict] = None,
-                      probes: Optional[dict] = None) -> None:
+                      probes: Optional[dict] = None,
+                      health: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
@@ -122,6 +134,8 @@ class SimulationEventSender:
                 r.update_failure_causes(round, causes)
             if probes is not None:
                 r.update_probes(round, probes)
+            if health is not None:
+                r.update_health(round, health)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -150,9 +164,12 @@ class SimulationEventSender:
         if "failed_drop" in stats:
             cause_arrs = {c: np.asarray(stats["failed_" + c])
                           for c in ("drop", "offline", "overflow")}
+        from ..telemetry.health import HEALTH_STAT_KEYS, health_event_row
         from ..telemetry.probes import PROBE_STAT_KEYS, probe_event_row
         probe_arrs = {k: np.asarray(stats[k]) for k in PROBE_STAT_KEYS
                       if k in stats}
+        health_arrs = {k: np.asarray(stats[k]) for k in HEALTH_STAT_KEYS
+                       if k in stats}
 
         def row(arr, i):
             vals = arr[i]
@@ -164,11 +181,13 @@ class SimulationEventSender:
             causes = ({c: int(a[i]) for c, a in cause_arrs.items()}
                       if cause_arrs is not None else None)
             probes = probe_event_row({k: a[i] for k, a in probe_arrs.items()})
+            health = health_event_row(
+                {k: a[i] for k, a in health_arrs.items()})
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
                                include_live=include_live, causes=causes,
-                               probes=probes)
+                               probes=probes, health=health)
         self._notify_end()
 
 
@@ -218,12 +237,61 @@ class ProgressReceiver(SimulationEventReceiver):
             self._win_sent = self._win_failed = 0
 
 
-class JSONLinesReceiver(SimulationEventReceiver):
-    """Append one JSON object per round to a file — the metric-sink hook the
-    reference lists as an open TODO ("Weights and Biases support",
-    README.md:50), kept tool-agnostic: any dashboard can tail the .jsonl.
+class CallbackReceiver(SimulationEventReceiver):
+    """Forward each round as ONE flat dict to a user callable — the
+    generic metric-sink the reference lists as an open TODO ("Weights
+    and Biases support", README.md:50). Any experiment tracker works
+    without a bespoke receiver class::
 
-    Line schema (``"schema": 3``), one object per round — versions are
+        import wandb
+        sim.add_receiver(CallbackReceiver(wandb.log))
+        # or TensorBoard:
+        sim.add_receiver(CallbackReceiver(
+            lambda row: [writer.add_scalar(k, v, row["round"])
+                         for k, v in row.items()
+                         if isinstance(v, (int, float))]))
+
+    Per round the callable receives ``{"round", "sent", "failed",
+    "size"}`` plus, when the run produces them, ``failed_by_cause``
+    (dict), ``local``/``global`` metric dicts, and the ``probes`` /
+    ``health`` rows (the same payloads ``update_probes`` /
+    ``update_health`` carry). Works replayed (default) or live
+    (``live=True``); callable exceptions propagate — wrap your sink if
+    it may fail.
+    """
+
+    def __init__(self, fn, live: bool = False):
+        self.fn = fn
+        self.live = bool(live)
+        self._row: dict = {}
+
+    def update_message(self, round, sent, failed, size):
+        self._row = {"round": round, "sent": sent, "failed": failed,
+                     "size": size}
+
+    def update_failure_causes(self, round, causes):
+        self._row["failed_by_cause"] = dict(causes)
+
+    def update_probes(self, round, probes):
+        self._row["probes"] = dict(probes)
+
+    def update_health(self, round, health):
+        self._row["health"] = dict(health)
+
+    def update_evaluation(self, round, on_user, metrics):
+        self._row["local" if on_user else "global"] = dict(metrics)
+
+    def update_timestep(self, round):
+        row, self._row = self._row, {}
+        self.fn(row)
+
+
+class JSONLinesReceiver(SimulationEventReceiver):
+    """Append one JSON object per round to a file, kept tool-agnostic:
+    any dashboard can tail the .jsonl (for a push-style sink — W&B,
+    TensorBoard — use :class:`CallbackReceiver` instead).
+
+    Line schema (``"schema": 4``), one object per round — versions are
     strictly additive, so a reader written against any version parses
     every later one by ignoring unknown keys (and every earlier one via
     :meth:`parse_line`, which fills absent fields with null):
@@ -248,6 +316,16 @@ class JSONLinesReceiver(SimulationEventReceiver):
                                     ``train_delta`` per the run's
                                     ``ProbeConfig`` (null without
                                     ``probes=``)
+        v4      ``health``          numerics-sentinel row ``| null``:
+                                    subsets of ``nonfinite_params``,
+                                    ``nonfinite_delta``,
+                                    ``nonfinite_metrics``,
+                                    ``first_bad_slot``, ``mix_nonfinite``,
+                                    ``diverged``, ``param_norm_max``,
+                                    ``delta_norm``, ``delta_hwm``,
+                                    ``mailbox_hwm_run``, ``trip`` per the
+                                    run's ``SentinelConfig`` (null
+                                    without ``sentinels=``)
         ======= =================== =====================================
 
     Works replayed (default) or live (``live=True`` streams rows during the
@@ -260,7 +338,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
-    SCHEMA = 3
+    SCHEMA = 4
 
     def __init__(self, path: str, live: bool = False):
         import json
@@ -273,7 +351,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     def update_message(self, round, sent, failed, size):
         self._row = {"schema": self.SCHEMA, "round": round, "sent": sent,
                      "failed": failed, "failed_by_cause": None,
-                     "size": size, "probes": None,
+                     "size": size, "probes": None, "health": None,
                      "local": None, "global": None}
 
     def update_failure_causes(self, round, causes):
@@ -281,6 +359,9 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     def update_probes(self, round, probes):
         self._row["probes"] = dict(probes)
+
+    def update_health(self, round, health):
+        self._row["health"] = dict(health)
 
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics
@@ -293,9 +374,9 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     @classmethod
     def parse_line(cls, line: str) -> dict:
-        """Version-tolerant row reader: normalize a v1/v2/v3 line into the
-        CURRENT schema's shape (fields a line's version predates come back
-        null, unknown future fields pass through untouched). The one
+        """Version-tolerant row reader: normalize a v1/v2/v3/v4 line into
+        the CURRENT schema's shape (fields a line's version predates come
+        back null, unknown future fields pass through untouched). The one
         reader consumers should use instead of re-encoding the version
         history themselves."""
         import json
@@ -305,6 +386,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
             row.setdefault("failed_by_cause", None)
         if schema < 3:
             row.setdefault("probes", None)
+        if schema < 4:
+            row.setdefault("health", None)
         return row
 
     def close(self):

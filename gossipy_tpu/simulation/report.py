@@ -43,8 +43,9 @@ from typing import Optional
 import numpy as np
 
 # 1: sent/failed/size/evals; 2: + cause breakdown & mailbox/compact diag;
-# 3: + gossip-dynamics probe arrays (probe_*) and the static probe context.
-REPORT_SCHEMA = 3
+# 3: + gossip-dynamics probe arrays (probe_*) and the static probe context;
+# 4: + numerics-sentinel health arrays (health_*; telemetry.health).
+REPORT_SCHEMA = 4
 
 # Optional per-round arrays (attribute name == JSON key), concatenated
 # along axis 0 by :meth:`SimulationReport.concatenate` (surviving only
@@ -64,6 +65,19 @@ PER_ROUND_FIELDS = (
     "probe_accepted_per_node",       # [R, N] i32
     "probe_merge_delta",             # [R] f32 (NaN when not decomposable)
     "probe_train_delta",             # [R] f32
+    "health_nonfinite_params",       # [R, L] i32: non-finite count per leaf
+    "health_nonfinite_delta",        # [R, L] i32: ... on the round delta
+    "health_nonfinite_metrics",      # [R] i32: ... in evaluated metric rows
+    "health_first_bad_slot",         # [R] i32: first deliver slot whose
+                                     # merge introduced a non-finite; -1 clean
+    "health_mix_nonfinite",          # [R] i32 (All2All): non-finite mixing
+                                     # weights this round
+    "health_diverged_per_node",      # [R, N] i32: norm-vs-EMA flags
+    "health_param_norm_max",         # [R] f32
+    "health_delta_norm",             # [R] f32: round movement L2
+    "health_delta_hwm",              # [R] f32: running high-water mark
+    "health_mailbox_hwm_run",        # [R] i32: run-level saturation watermark
+    "health_trip",                   # [R] i32: any sentinel tripped
     "wall_clock_seconds_per_round",  # [R] f64 (live runs only)
 )
 
@@ -72,6 +86,7 @@ PER_ROUND_FIELDS = (
 STATIC_FIELDS = (
     "probe_layer_names",      # [L] list[str]: consensus per-layer ordering
     "probe_expected_fanin",   # [N] f64: topology's expected accepted fan-in
+    "health_layer_names",     # [L] list[str]: health per-leaf ordering
 )
 
 # Integer-valued per-round fields (restored as int arrays by from_dict).
@@ -79,6 +94,10 @@ _INT_FIELDS = frozenset({
     "mailbox_hwm_per_round", "compact_slots_per_round",
     "wide_slots_per_round", "probe_stale_max", "probe_stale_hist",
     "probe_accepted_per_node",
+    "health_nonfinite_params", "health_nonfinite_delta",
+    "health_nonfinite_metrics", "health_first_bad_slot",
+    "health_mix_nonfinite", "health_diverged_per_node",
+    "health_mailbox_hwm_run", "health_trip",
 })
 
 

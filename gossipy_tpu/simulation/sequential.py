@@ -52,6 +52,11 @@ from ..core import AntiEntropyProtocol, ConstantDelay, CreateModelMode, \
     Delay, MessageType
 from ..flow_control import TokenAccount
 from ..handlers.base import BaseHandler, ModelState, PeerModel
+from ..telemetry.health import (
+    HealthCarry,
+    SentinelConfig,
+    health_round_stats,
+)
 from ..telemetry.probes import (
     ProbeConfig,
     consensus_stats,
@@ -119,7 +124,8 @@ class SequentialGossipSimulator(SimulationEventSender):
                  sync: bool = True,
                  token_account: Optional[TokenAccount] = None,
                  utility_fun: Optional[Callable] = None,
-                 probes=None):
+                 probes=None,
+                 sentinels=None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         self.handler = handler
         self.topology = topology
@@ -176,6 +182,15 @@ class SequentialGossipSimulator(SimulationEventSender):
         if self.probes is not None:
             self._jit_sqdist = jax.jit(sq_param_distance)
             self._jit_consensus = jax.jit(consensus_stats)
+        # Numerics sentinels (telemetry.health): the SAME per-round
+        # vitals the jitted engine computes in-graph, here over eagerly
+        # stacked round-boundary params — the verification side of the
+        # jitted-vs-sequential health parity tests.
+        self.sentinels: Optional[SentinelConfig] = \
+            SentinelConfig.coerce(sentinels)
+        # Cross-run divergence-EMA state, same contract as the jitted
+        # engine: persists across start() calls, reset by init_nodes.
+        self._health_carry: Optional[HealthCarry] = None
 
         def eval_global(stacked, xe, ye, me):
             return jax.vmap(lambda m: handler.evaluate(m, (xe, ye, me)))(
@@ -207,6 +222,7 @@ class SequentialGossipSimulator(SimulationEventSender):
     def init_nodes(self, key: jax.Array, local_train: bool = True,
                    common_init: bool = False) -> SeqState:
         n = self.n_nodes
+        self._health_carry = None  # fresh population, fresh sentinel EMA
         k_init, k_phase, k_up = jax.random.split(key, 3)
         models = []
         for i in range(n):
@@ -288,6 +304,24 @@ class SequentialGossipSimulator(SimulationEventSender):
             cons_mean = np.zeros(n_rounds, np.float64)
             cons_max = np.zeros(n_rounds, np.float64)
             cons_layers = np.zeros((n_rounds, n_layers), np.float64)
+        sentinels = self.sentinels
+        if sentinels is not None:
+            L = len(param_layer_names(state.models[0].params))
+            hc = (self._health_carry if self._health_carry is not None
+                  else HealthCarry.zeros(n))
+            h_nf_params = np.zeros((n_rounds, L), np.int64)
+            h_nf_delta = np.zeros((n_rounds, L), np.int64)
+            h_nf_metrics = np.zeros(n_rounds, np.int64)
+            h_diverged = np.zeros((n_rounds, n), np.int64)
+            h_norm_max = np.zeros(n_rounds, np.float64)
+            h_delta_norm = np.zeros(n_rounds, np.float64)
+            h_delta_hwm = np.zeros(n_rounds, np.float64)
+            h_trip = np.zeros(n_rounds, np.int64)
+            pre_params = None
+
+            def stack_params():
+                return jax.tree.map(lambda *ls: jnp.stack(ls),
+                                    *[m.params for m in state.models])
         # ONE monotonically increasing event counter feeds every jax-side
         # draw (handler calls, delay samples): each draw gets a globally
         # unique fold, so no two events — same tick, same sender, or
@@ -409,6 +443,8 @@ class SequentialGossipSimulator(SimulationEventSender):
             r = t // delta
             if t % delta == 0:
                 order = rng.permutation(n)
+                if sentinels is not None:
+                    pre_params = stack_params()  # round-start snapshot
             # (a) send sweep over the round's shuffled order.
             for i in order:
                 if not self._fires(state, int(i), t):
@@ -450,6 +486,29 @@ class SequentialGossipSimulator(SimulationEventSender):
                     cons_mean[r] = float(cm)
                     cons_max[r] = float(cx)
                     cons_layers[r] = np.asarray(cl)
+                if sentinels is not None:
+                    # Same vitals definition as the jitted engine's scan
+                    # body (health_round_stats is the shared pure math).
+                    hc, hstats = health_round_stats(
+                        sentinels, hc, pre_params, stack_params(),
+                        jnp.asarray(local_rows[r]),
+                        jnp.asarray(global_rows[r]))
+                    if sentinels.nonfinite:
+                        h_nf_params[r] = np.asarray(
+                            hstats["health_nonfinite_params"])
+                        h_nf_delta[r] = np.asarray(
+                            hstats["health_nonfinite_delta"])
+                        h_nf_metrics[r] = int(
+                            hstats["health_nonfinite_metrics"])
+                    if sentinels.divergence:
+                        h_diverged[r] = np.asarray(
+                            hstats["health_diverged_per_node"])
+                        h_norm_max[r] = float(
+                            hstats["health_param_norm_max"])
+                    h_delta_norm[r] = float(hstats["health_delta_norm"])
+                    h_delta_hwm[r] = float(hstats["health_delta_hwm"])
+                    h_trip[r] = int(hstats["health_trip"])
+                    self._health_carry = hc
                 state.round += 1
 
         extras: dict = {}
@@ -476,6 +535,19 @@ class SequentialGossipSimulator(SimulationEventSender):
                     extras["probe_merge_delta"] = nan_pr
                     extras["probe_train_delta"] = nan_pr.copy()
                 extras["probe_expected_fanin"] = self._probe_expected_fanin()
+        if sentinels is not None:
+            if sentinels.nonfinite:
+                extras["health_nonfinite_params"] = h_nf_params
+                extras["health_nonfinite_delta"] = h_nf_delta
+                extras["health_nonfinite_metrics"] = h_nf_metrics
+                extras["health_layer_names"] = param_layer_names(
+                    state.models[0].params)
+            if sentinels.divergence:
+                extras["health_diverged_per_node"] = h_diverged
+                extras["health_param_norm_max"] = h_norm_max
+            extras["health_delta_norm"] = h_delta_norm
+            extras["health_delta_hwm"] = h_delta_hwm
+            extras["health_trip"] = h_trip
         report = SimulationReport(
             metric_names=names,
             local_evals=local_rows if self.has_local_test else None,
@@ -489,10 +561,12 @@ class SequentialGossipSimulator(SimulationEventSender):
             "failed_drop": drop_pr, "failed_offline": offline_pr,
             "failed_overflow": overflow_pr, "size": size_pr,
             "local": local_rows, "global": global_rows,
-            # Per-round probe arrays ride the same replay so receivers get
-            # update_probes from this engine too (static context excluded).
+            # Per-round probe/health arrays ride the same replay so
+            # receivers get update_probes/update_health from this engine
+            # too (static context excluded).
             **{k: v for k, v in extras.items()
-               if k not in ("probe_layer_names", "probe_expected_fanin")}},
+               if k not in ("probe_layer_names", "probe_expected_fanin",
+                            "health_layer_names")}},
             names)
         return state, report
 

@@ -311,6 +311,14 @@ class All2AllGossipSimulator(GossipSimulator):
         # here — the mix and the local update are separate phases.
         probe_mix = self.probes is not None and (self.probes.mixing
                                                  or self.probes.staleness)
+        # All2All-branch sentinel vital (telemetry.health): the effective
+        # mixing weights are the one quantity this round shape owns that
+        # the engine-generic vitals cannot see — a non-finite weight
+        # (degenerate row renormalization) poisons every leaf it touches
+        # before any param goes bad. Counted per round across whichever
+        # formulation (dense / padded / segment) this simulator compiled.
+        health_nf = self.sentinels is not None and self.sentinels.nonfinite
+        mix_bad = None
         acc_count = None
         merge_sq = train_sq = jnp.float32(0)
         with jax.named_scope(PHASE_SEND):
@@ -336,6 +344,10 @@ class All2AllGossipSimulator(GossipSimulator):
             inv = 1.0 / jnp.maximum(row_sum, 1e-12)
             w_eff = w * inv[:, None]
             self_eff = self.mixing.self_w * inv
+            if health_nf:
+                mix_bad = ((~jnp.isfinite(w_eff)).sum()
+                           + (~jnp.isfinite(self_eff)).sum()) \
+                    .astype(jnp.int32)
 
             def mix_tree(params):
                 # Peer contributions travel the wire: gather the wire-format
@@ -385,6 +397,10 @@ class All2AllGossipSimulator(GossipSimulator):
             inv = 1.0 / jnp.maximum(row_sum, 1e-12)
             w_e_eff = w_e * inv[mix.rows]
             self_eff = mix.self_w * inv
+            if health_nf:
+                mix_bad = ((~jnp.isfinite(w_e_eff)).sum()
+                           + (~jnp.isfinite(self_eff)).sum()) \
+                    .astype(jnp.int32)
 
             def mix_tree(params):
                 wire = (params if self.history_dtype == "float32"
@@ -427,6 +443,8 @@ class All2AllGossipSimulator(GossipSimulator):
             w = w + jnp.diag(jnp.diag(self.mixing))  # self weight always present
             row_sum = w.sum(axis=1, keepdims=True)
             w_eff = w / jnp.maximum(row_sum, 1e-12)
+            if health_nf:
+                mix_bad = (~jnp.isfinite(w_eff)).sum().astype(jnp.int32)
 
             sent_mask = adj & fires[None, :]
             n_sent = sent_mask.sum()
@@ -566,6 +584,8 @@ class All2AllGossipSimulator(GossipSimulator):
                 stats["probe_accepted_per_node"] = acc_count
                 stats["probe_merge_delta"] = jnp.sqrt(merge_sq)
                 stats["probe_train_delta"] = jnp.sqrt(train_sq)
+        if health_nf:
+            stats["health_mix_nonfinite"] = mix_bad
         return state, stats
 
     def _probe_expected_fanin(self):

@@ -18,9 +18,28 @@ other way, so everything here is importable standalone):
 - :mod:`.probes` — :class:`ProbeConfig` and the traced gossip-dynamics
   probe math (consensus distance, merge staleness, realized mixing) the
   engines compute inside the jitted round loop when ``probes=`` is set.
+- :mod:`.health` — :class:`SentinelConfig` and the traced numerics
+  sentinels (non-finite counts, divergence flags, saturation
+  watermarks) the engines compute when ``sentinels=`` is set, plus the
+  anomaly-triggered :class:`FlightRecorder` and its
+  :func:`replay_bundle` deterministic-replay counterpart.
 """
 
 from .causes import FAILURE_CAUSES, FailureCounts
+from .health import (
+    BUNDLE_VERSION,
+    HEALTH_STAT_KEYS,
+    FlightRecorder,
+    HealthCarry,
+    SentinelConfig,
+    health_event_row,
+    health_round_stats,
+    localize_first_nonfinite,
+    nonfinite_counts,
+    nonfinite_total,
+    per_node_param_norm,
+    replay_bundle,
+)
 from .manifest import MANIFEST_SCHEMA, RunManifest, git_revision
 from .probes import (
     PROBE_STAT_KEYS,
@@ -52,4 +71,8 @@ __all__ = [
     "TelemetryEvent", "TelemetrySink", "emit_event", "get_sink", "set_sink",
     "ProbeConfig", "ProbeAccum", "PROBE_STAT_KEYS", "consensus_stats",
     "param_layer_names", "probe_event_row",
+    "SentinelConfig", "HealthCarry", "HEALTH_STAT_KEYS", "BUNDLE_VERSION",
+    "FlightRecorder", "health_event_row", "health_round_stats",
+    "localize_first_nonfinite", "nonfinite_counts", "nonfinite_total",
+    "per_node_param_norm", "replay_bundle",
 ]

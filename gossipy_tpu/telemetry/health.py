@@ -1,0 +1,637 @@
+"""Numerics sentinels + anomaly-triggered flight recorder.
+
+The round-5 postmortem of the 50k-node on-TPU crash had to *rank
+hypotheses* because the run's traceback was lost (ROUND5_NOTES §2). This
+module closes that gap with the two facilities production pjit/TPU
+training stacks treat as table stakes:
+
+- **Sentinels** (``GossipSimulator(sentinels=True | SentinelConfig)``):
+  per-round numerical-health vitals computed INSIDE the jitted round
+  program, the same design discipline as the gossip-dynamics probes —
+  ``sentinels=None`` (default) traces the identical HLO:
+
+  * non-finite counts on the params and on the round's param delta,
+    per parameter leaf, plus non-finite entries in the round's evaluated
+    metric rows;
+  * per-node divergence flags — a node whose param L2 norm exceeds a
+    configurable multiple of its own EMA — and the population-max norm;
+  * the round-delta norm (how far the whole population moved) with its
+    running high-water mark, and the run-level mailbox-saturation
+    watermark (the traced counterpart of the construction-time
+    undersized-mailbox warning);
+  * a per-round ``health_trip`` flag: any non-finite count or divergence
+    flag fired this round.
+
+- **Flight recorder** (:class:`FlightRecorder`): drives a run in chunks
+  and, when a sentinel trips, the run raises, or the watchdog fires,
+  writes a self-contained repro bundle — the last healthy
+  :class:`~gossipy_tpu.simulation.engine.SimState` checkpoint + PRNG key
+  + round index (reusing :mod:`gossipy_tpu.checkpoint`), the
+  :class:`~gossipy_tpu.telemetry.RunManifest`, the trailing telemetry
+  events from the sink ring, and the sentinel verdict.
+  :func:`replay_bundle` (CLI: ``scripts/replay_bundle.py``) restores the
+  bundle and replays the offending rounds deterministically, naming the
+  first divergent round, parameter leaf and node set, and eagerly
+  re-executing the offending round phase by phase (``jax.disable_jit``)
+  to localize which engine phase introduced the first non-finite value.
+
+Everything traced here is engine-agnostic pure math (the dependency
+points from the engines to this module, like the rest of
+:mod:`gossipy_tpu.telemetry`): the jitted engine, the All2All variant
+and the sequential high-fidelity engine compute the same vitals through
+these helpers, so jitted-vs-sequential health parity is testable.
+
+Bundle directory schema (``BUNDLE_VERSION`` 1)::
+
+    <bundle>/
+      checkpoint/      orbax snapshot: {"state": SimState, "key": PRNGKey}
+                       (state.round == the last HEALTHY round boundary)
+      manifest.json    RunManifest of the recorded simulator
+                       (extra.flight_recorder carries the bundle block)
+      verdict.json     {"bundle_version", "kind": "sentinel" | "exception"
+                        | "watchdog", "chunk_start_round",
+                        "first_bad_round" | null, "detail": {...}}
+      events.jsonl     trailing telemetry events from the sink ring
+                       (per-round rows the recorder mirrors in, plus any
+                       engine diagnostics), oldest first
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .probes import param_layer_names
+
+BUNDLE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class SentinelConfig:
+    """Which numerical-health sentinels a simulator computes per round.
+
+    - ``nonfinite``: per-leaf non-finite counts on params / round delta /
+      evaluated metrics, and the first mailbox slot whose delivery
+      introduced a non-finite value.
+    - ``divergence``: per-node param-norm-vs-own-EMA divergence flags.
+    - ``saturation``: run-level mailbox occupancy watermark.
+    - ``ema_alpha``: EMA coefficient for the per-node norm tracker.
+    - ``divergence_factor``: a node trips when its param norm exceeds
+      ``divergence_factor * max(ema, norm_floor)``.
+    - ``norm_floor``: keeps near-zero EMAs (fresh zero-init models) from
+      tripping on the first real update.
+    """
+
+    nonfinite: bool = True
+    divergence: bool = True
+    saturation: bool = True
+    ema_alpha: float = 0.1
+    divergence_factor: float = 10.0
+    norm_floor: float = 1e-6
+
+    def __post_init__(self):
+        if not 0.0 < self.ema_alpha <= 1.0:
+            raise ValueError("ema_alpha must be in (0, 1]")
+        if self.divergence_factor <= 1.0:
+            raise ValueError("divergence_factor must be > 1 (a node is "
+                             "flagged when its norm EXCEEDS the EMA by "
+                             "this factor)")
+
+    @classmethod
+    def coerce(cls, sentinels: Union[None, bool, "SentinelConfig"]
+               ) -> Optional["SentinelConfig"]:
+        """Normalize the ``sentinels=`` constructor argument:
+        ``None``/``False`` → off (None), ``True`` → all sentinels at
+        defaults, a :class:`SentinelConfig` → itself (None when every
+        sentinel is off)."""
+        if sentinels is None or sentinels is False:
+            return None
+        if sentinels is True:
+            return cls()
+        if isinstance(sentinels, cls):
+            if not (sentinels.nonfinite or sentinels.divergence
+                    or sentinels.saturation):
+                return None
+            return sentinels
+        raise TypeError(f"sentinels= expects None, bool or SentinelConfig; "
+                        f"got {type(sentinels).__name__}")
+
+    def to_dict(self) -> dict:
+        return {"nonfinite": self.nonfinite, "divergence": self.divergence,
+                "saturation": self.saturation, "ema_alpha": self.ema_alpha,
+                "divergence_factor": self.divergence_factor,
+                "norm_floor": self.norm_floor}
+
+
+class HealthCarry(NamedTuple):
+    """Cross-round sentinel state threaded through the round scan's carry
+    (the EMA and the high-water marks survive from round to round; the
+    per-round vitals land in the stats dict)."""
+
+    norm_ema: jax.Array         # [N] f32: per-node param-norm EMA
+    rounds_seen: jax.Array      # i32: rounds folded into the EMA
+    delta_hwm: jax.Array        # f32: high-water mark of the round-delta norm
+    mailbox_hwm_run: jax.Array  # i32: run-level mailbox occupancy watermark
+
+    @staticmethod
+    def zeros(n: int) -> "HealthCarry":
+        return HealthCarry(
+            norm_ema=jnp.zeros((n,), jnp.float32),
+            rounds_seen=jnp.int32(0),
+            delta_hwm=jnp.float32(0),
+            mailbox_hwm_run=jnp.int32(0),
+        )
+
+
+def nonfinite_counts(tree: Any) -> jax.Array:
+    """[L] int32: non-finite scalar count per leaf of ``tree``
+    (``tree_leaves`` order; names via
+    :func:`~gossipy_tpu.telemetry.probes.param_layer_names`). Computed in
+    fp32 regardless of the leaves' storage dtype (integer leaves are
+    always finite and count 0)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.stack([
+        (~jnp.isfinite(l.astype(jnp.float32))).sum().astype(jnp.int32)
+        for l in leaves])
+
+
+def nonfinite_total(tree: Any) -> jax.Array:
+    """Scalar int32: total non-finite count over every leaf of ``tree``."""
+    total = jnp.int32(0)
+    for l in jax.tree_util.tree_leaves(tree):
+        total = total + (~jnp.isfinite(l.astype(jnp.float32))).sum() \
+            .astype(jnp.int32)
+    return total
+
+
+def per_node_param_norm(params: Any) -> jax.Array:
+    """[N] f32: each node's param L2 norm over stacked params (leaves
+    ``[N, ...]``), computed in fp32."""
+    leaves = jax.tree_util.tree_leaves(params)
+    n = leaves[0].shape[0]
+    total = jnp.zeros((n,), jnp.float32)
+    for l in leaves:
+        x = l.astype(jnp.float32).reshape(n, -1)
+        total = total + (x * x).sum(axis=1)
+    return jnp.sqrt(total)
+
+
+# Per-round health stat keys the engines emit (and the report/event
+# layers consume), in the fixed order the live io_callback positional
+# protocol relies on. ``health_first_bad_slot`` is base-engine only
+# (mailbox slot loop); ``health_mix_nonfinite`` is All2All only — both
+# layers handle subsets, like the probe keys.
+HEALTH_STAT_KEYS = (
+    "health_nonfinite_params",
+    "health_nonfinite_delta",
+    "health_nonfinite_metrics",
+    "health_first_bad_slot",
+    "health_mix_nonfinite",
+    "health_diverged_per_node",
+    "health_param_norm_max",
+    "health_delta_norm",
+    "health_delta_hwm",
+    "health_mailbox_hwm_run",
+    "health_trip",
+)
+
+
+def health_round_stats(cfg: SentinelConfig, hc: HealthCarry,
+                       pre_params: Any, params: Any,
+                       local_metrics: Optional[jax.Array],
+                       global_metrics: Optional[jax.Array],
+                       mailbox_hwm: Optional[jax.Array] = None,
+                       ) -> tuple[HealthCarry, dict]:
+    """One round's sentinel vitals (pure math; traced by the jitted
+    engines, eager in the sequential one).
+
+    ``pre_params``/``params`` are the round-start / round-end stacked
+    params; ``local_metrics``/``global_metrics`` the round's evaluated
+    metric vectors (an all-NaN row means evaluation was SKIPPED this
+    round — the engine's ``eval_every`` contract — and counts zero, so
+    the skip marker never trips the sentinel). Returns the advanced
+    carry and the round's ``health_*`` stats entries.
+    """
+    out: dict = {}
+    nf_any: Any = False
+    div_any: Any = False
+    # The round's param delta feeds both the non-finite sentinel and the
+    # delta-norm vital — compute it once.
+    delta = jax.tree.map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+        params, pre_params)
+    if cfg.nonfinite:
+        nf_p = nonfinite_counts(params)
+        nf_d = nonfinite_counts(delta)
+        out["health_nonfinite_params"] = nf_p
+        out["health_nonfinite_delta"] = nf_d
+        m = jnp.int32(0)
+        for v in (local_metrics, global_metrics):
+            if v is None:
+                continue
+            ran = ~jnp.all(jnp.isnan(v))
+            m = m + jnp.where(ran, (~jnp.isfinite(v)).sum(), 0) \
+                .astype(jnp.int32)
+        out["health_nonfinite_metrics"] = m
+        nf_any = (nf_p.sum() + nf_d.sum() + m) > 0
+
+    norms = per_node_param_norm(params)
+    if cfg.divergence:
+        seeded = hc.rounds_seen > 0
+        ema = jnp.where(seeded, hc.norm_ema, norms)
+        threshold = cfg.divergence_factor * jnp.maximum(ema, cfg.norm_floor)
+        flags = (seeded & (norms > threshold)).astype(jnp.int32)
+        # Non-finite norms stay out of the EMA (one NaN round must not
+        # poison the baseline the healthy rounds are judged against).
+        finite = jnp.isfinite(norms)
+        new_ema = jnp.where(
+            finite, (1.0 - cfg.ema_alpha) * ema + cfg.ema_alpha * norms, ema)
+        hc = hc._replace(norm_ema=new_ema)
+        out["health_diverged_per_node"] = flags
+        out["health_param_norm_max"] = jnp.max(norms).astype(jnp.float32)
+        div_any = flags.sum() > 0
+
+    delta_norm = jnp.sqrt(sum(
+        (d * d).sum() for d in jax.tree_util.tree_leaves(delta))
+        .astype(jnp.float32))
+    new_hwm = jnp.where(jnp.isfinite(delta_norm),
+                        jnp.maximum(hc.delta_hwm, delta_norm), hc.delta_hwm)
+    out["health_delta_norm"] = delta_norm
+    out["health_delta_hwm"] = new_hwm
+    hc = hc._replace(delta_hwm=new_hwm, rounds_seen=hc.rounds_seen + 1)
+
+    if cfg.saturation and mailbox_hwm is not None:
+        run_hwm = jnp.maximum(hc.mailbox_hwm_run,
+                              mailbox_hwm.astype(jnp.int32))
+        hc = hc._replace(mailbox_hwm_run=run_hwm)
+        out["health_mailbox_hwm_run"] = run_hwm
+
+    trip = jnp.asarray(nf_any) | jnp.asarray(div_any)
+    out["health_trip"] = trip.astype(jnp.int32)
+    return hc, out
+
+
+def health_event_row(vals: dict) -> Optional[dict]:
+    """The per-round ``update_health`` observer payload (JSON-able
+    scalars) from one round's health values — keys for disabled
+    sentinels are simply absent. Returns None when ``vals`` carries no
+    health stat at all."""
+    if not vals:
+        return None
+    row: dict = {}
+    if "health_nonfinite_params" in vals:
+        row["nonfinite_params"] = int(
+            np.asarray(vals["health_nonfinite_params"]).sum())
+        row["nonfinite_delta"] = int(
+            np.asarray(vals["health_nonfinite_delta"]).sum())
+        row["nonfinite_metrics"] = int(vals["health_nonfinite_metrics"])
+    if "health_first_bad_slot" in vals:
+        row["first_bad_slot"] = int(vals["health_first_bad_slot"])
+    if "health_mix_nonfinite" in vals:
+        row["mix_nonfinite"] = int(vals["health_mix_nonfinite"])
+    if "health_diverged_per_node" in vals:
+        row["diverged"] = int(
+            np.asarray(vals["health_diverged_per_node"]).sum())
+        row["param_norm_max"] = float(vals["health_param_norm_max"])
+    if "health_delta_norm" in vals:
+        row["delta_norm"] = float(vals["health_delta_norm"])
+        row["delta_hwm"] = float(vals["health_delta_hwm"])
+    if "health_mailbox_hwm_run" in vals:
+        row["mailbox_hwm_run"] = int(vals["health_mailbox_hwm_run"])
+    if "health_trip" in vals:
+        row["trip"] = bool(int(vals["health_trip"]))
+    return row or None
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def _first_trip_index(report) -> Optional[int]:
+    """0-based index of the first tripped round in a report's
+    ``health_trip`` array, or None."""
+    trips = getattr(report, "health_trip", None)
+    if trips is None:
+        return None
+    idx = np.nonzero(np.asarray(trips) > 0)[0]
+    return int(idx[0]) if idx.size else None
+
+
+class FlightRecorder:
+    """Chunked run driver that captures a repro bundle on anomaly.
+
+    Drives ``sim.start`` in ``chunk``-round segments, keeping the
+    segment-start state as the last healthy checkpoint (randomness is
+    keyed on the absolute round number, so segmentation does not change
+    the trajectory). On the first tripped sentinel round, an exception
+    out of ``start``, or the per-chunk watchdog deadline, the bundle is
+    written (see the module doc for the directory schema) and recording
+    stops. The recorder also mirrors each round's event row into the
+    process telemetry sink (kind ``"round"``), so the bundle's
+    ``events.jsonl`` carries the trailing per-round history; when the
+    sink ring's eviction truncated that window, a warning says so once.
+
+    Usage::
+
+        rec = FlightRecorder(out_dir, chunk=50)
+        state, reports, bundle = rec.run(sim, state, n_rounds=1000, key=key)
+        if bundle is not None:
+            ...  # scripts/replay_bundle.py <bundle> localizes the fault
+    """
+
+    def __init__(self, out_dir: str, chunk: int = 50,
+                 trailing_rounds: int = 64,
+                 watchdog_seconds: Optional[float] = None):
+        self.out_dir = os.path.abspath(out_dir)
+        self.chunk = int(chunk)
+        assert self.chunk >= 1
+        self.trailing_rounds = int(trailing_rounds)
+        self.watchdog_seconds = watchdog_seconds
+        self.bundle_path: Optional[str] = None
+        self._rounds_recorded = 0
+        self._warned_truncated = False
+
+    # -- bundle writing ----------------------------------------------------
+
+    def _write_bundle(self, sim, state, key, kind: str,
+                      chunk_start_round: int,
+                      first_bad_round: Optional[int] = None,
+                      detail: Optional[dict] = None) -> str:
+        """Write the repro bundle for ``state`` (the last HEALTHY state,
+        at round ``chunk_start_round``). Returns the bundle path; never
+        raises past best effort — a recorder failure must not mask the
+        run's own failure."""
+        from ..checkpoint import save_checkpoint
+        from .sink import get_sink
+
+        name = f"bundle_r{chunk_start_round:06d}_{kind}"
+        path = os.path.join(self.out_dir, name)
+        os.makedirs(path, exist_ok=True)
+        save_checkpoint(os.path.join(path, "checkpoint"), state, key=key,
+                        meta={"bundle_version": BUNDLE_VERSION,
+                              "kind": kind,
+                              "round": int(chunk_start_round)})
+
+        verdict = {
+            "bundle_version": BUNDLE_VERSION,
+            "kind": kind,
+            "chunk_start_round": int(chunk_start_round),
+            "first_bad_round": (int(first_bad_round)
+                                if first_bad_round is not None else None),
+            "detail": detail or {},
+        }
+        with open(os.path.join(path, "verdict.json"), "w") as fh:
+            json.dump(verdict, fh, indent=2)
+            fh.write("\n")
+
+        try:
+            sim.run_manifest(extra={"flight_recorder": {
+                "bundle_version": BUNDLE_VERSION, "kind": kind,
+                "chunk_start_round": int(chunk_start_round),
+                "trailing_rounds": self.trailing_rounds,
+            }}).save(os.path.join(path, "manifest.json"))
+        except Exception as e:  # manifest is context, not the evidence
+            warnings.warn(f"flight recorder could not collect the run "
+                          f"manifest: {e!r}")
+
+        sink = get_sink()
+        events = sink.events()
+        round_events = [e for e in events if e.kind == "round"]
+        want = min(self.trailing_rounds, self._rounds_recorded)
+        if len(round_events) < want and sink.dropped_events > 0 \
+                and not self._warned_truncated:
+            self._warned_truncated = True
+            warnings.warn(
+                f"flight recorder trailing window truncated: the telemetry "
+                f"sink ring evicted {sink.dropped_events} events "
+                f"(maxlen {sink.maxlen}); the bundle carries "
+                f"{len(round_events)} of the requested {want} trailing "
+                f"rounds. Install a larger TelemetrySink to keep more.")
+        with open(os.path.join(path, "events.jsonl"), "w") as fh:
+            for ev in events[-max(self.trailing_rounds, 1) * 2:]:
+                fh.write(json.dumps(ev.to_dict()) + "\n")
+
+        self.bundle_path = path
+        return path
+
+    # -- driving -----------------------------------------------------------
+
+    def run(self, sim, state, n_rounds: int, key,
+            ) -> tuple[Any, list, Optional[str]]:
+        """Run ``n_rounds`` rounds in chunks; returns ``(state, reports,
+        bundle_path)`` where ``bundle_path`` is None for a clean run. On
+        an exception out of ``sim.start`` the bundle is written first,
+        then the exception re-raised."""
+        assert getattr(sim, "sentinels", None) is not None, \
+            "FlightRecorder needs a sentinel-enabled simulator " \
+            "(GossipSimulator(sentinels=True))"
+        from ..simulation.events import CallbackReceiver
+        from .sink import emit_event
+
+        tap = CallbackReceiver(
+            lambda row: emit_event("round", row), live=False)
+        sim.add_receiver(tap)
+        reports: list = []
+        bundle: Optional[str] = None
+        try:
+            done = 0
+            while done < n_rounds:
+                c = min(self.chunk, n_rounds - done)
+                start_state = state
+                start_round = int(np.asarray(state.round))
+                timer = None
+                if self.watchdog_seconds is not None:
+                    timer = threading.Timer(
+                        self.watchdog_seconds, self._write_bundle,
+                        args=(sim, start_state, key, "watchdog",
+                              start_round),
+                        kwargs={"detail": {
+                            "watchdog_seconds": self.watchdog_seconds}})
+                    timer.daemon = True
+                    timer.start()
+                try:
+                    state, report = sim.start(state, n_rounds=c, key=key,
+                                              donate_state=False)
+                    jax.block_until_ready(
+                        jax.tree_util.tree_leaves(state.model.params)[0])
+                except Exception as e:
+                    bundle = self._write_bundle(
+                        sim, start_state, key, "exception", start_round,
+                        detail={"error": repr(e)[:500]})
+                    raise
+                finally:
+                    if timer is not None:
+                        timer.cancel()
+                self._rounds_recorded += c
+                reports.append(report)
+                idx = _first_trip_index(report)
+                if idx is not None:
+                    bundle = self._write_bundle(
+                        sim, start_state, key, "sentinel", start_round,
+                        first_bad_round=start_round + idx,
+                        detail=_trip_detail(sim, report, idx))
+                    break
+                done += c
+        finally:
+            sim.remove_receiver(tap)
+        if bundle is None and self.bundle_path is not None:
+            bundle = self.bundle_path  # watchdog fired mid-chunk
+        return state, reports, bundle
+
+
+def _trip_detail(sim, report, idx: int) -> dict:
+    """JSON-able summary of the tripped round ``idx`` (0-based within the
+    report) for the bundle verdict."""
+    detail: dict = {}
+
+    def arr(name):
+        v = getattr(report, name, None)
+        return None if v is None else np.asarray(v[idx])
+
+    nf = arr("health_nonfinite_params")
+    if nf is not None:
+        detail["nonfinite_params_total"] = int(nf.sum())
+        if nf.sum() > 0:
+            names = _layer_names(sim)
+            bad = [names[i] if names and i < len(names) else str(i)
+                   for i in np.nonzero(nf > 0)[0]]
+            detail["nonfinite_leaves"] = bad
+    flags = arr("health_diverged_per_node")
+    if flags is not None:
+        detail["diverged_nodes"] = [int(i) for i in
+                                    np.nonzero(flags > 0)[0][:32]]
+    for name, key in (("health_delta_norm", "delta_norm"),
+                      ("health_param_norm_max", "param_norm_max")):
+        v = arr(name)
+        if v is not None:
+            # Strict JSON: a NaN vital (the usual case on the tripped
+            # round) serializes as null, not a bare NaN token.
+            detail[key] = float(v) if np.isfinite(v) else None
+    return detail
+
+
+def _layer_names(sim) -> Optional[list]:
+    try:
+        st = jax.eval_shape(sim.handler.init, jax.random.PRNGKey(0))
+        return param_layer_names(st.params)
+    except Exception:
+        return None
+
+
+# -- replay -----------------------------------------------------------------
+
+
+def localize_first_nonfinite(sim, state, key) -> dict:
+    """Eagerly re-execute ONE round phase by phase (``jax.disable_jit``)
+    from ``state`` and name the first engine phase after which the
+    model params carry a non-finite value. Only meaningful for
+    simulators using the base round decomposition (variants overriding
+    ``_round`` wholesale, e.g. All2All, report phase ``"round"``)."""
+    from ..simulation.engine import GossipSimulator
+    if type(sim)._round is not GossipSimulator._round:
+        return {"phase": "round"}
+    r = state.round
+    with jax.disable_jit():
+        st = sim._pre_send(state, key, r)
+        st = sim._snapshot(st, r)
+        st, _, _, _ = sim._send_phase(st, key, r)
+        phases = [("send", st)]
+        st, _, _, _, _ = sim._deliver_phase(st, key, r)
+        phases.append(("receive_merge", st))
+        st, _, _ = sim._reply_phase(st, key, r)
+        phases.append(("reply", st))
+    for phase, st in phases:
+        if int(np.asarray(nonfinite_total(st.model.params))) > 0:
+            return {"phase": phase}
+    return {"phase": "eval_or_none"}
+
+
+def replay_bundle(bundle_dir: str, sim, max_rounds: Optional[int] = None,
+                  localize: bool = True) -> dict:
+    """Restore a flight-recorder bundle into ``sim`` and replay forward
+    deterministically until the first tripped round.
+
+    ``sim`` must be built with the SAME configuration as the recorded
+    run (the bundle's ``manifest.json`` ``config`` block says what that
+    was) and with sentinels enabled. Rounds are replayed one at a time
+    (randomness is keyed on the absolute round number, so the 1-round
+    segmentation reproduces the recorded trajectory); each round's
+    sentinel verdict is read back on the host, so the first divergent
+    round, parameter leaf and node set are named exactly.
+
+    Returns a verdict dict::
+
+        {"first_bad_round": int | None,     # absolute round index
+         "trip": "nonfinite" | "divergence" | None,
+         "leaf": str | None,                # first non-finite leaf
+         "leaf_index": int | None,
+         "nodes": [int, ...],               # affected node ids (<= 32)
+         "nonfinite_per_leaf": [int, ...],
+         "phase": str | None,               # eager per-phase localization
+         "start_round": int,
+         "matches_recorded": bool | None}   # vs the bundle's verdict
+    """
+    assert getattr(sim, "sentinels", None) is not None, \
+        "replay needs a sentinel-enabled simulator (sentinels=True)"
+    from ..checkpoint import restore_checkpoint
+
+    with open(os.path.join(bundle_dir, "verdict.json")) as fh:
+        recorded = json.load(fh)
+
+    template = sim.init_nodes(jax.random.PRNGKey(0), local_train=False)
+    state, key = restore_checkpoint(
+        os.path.join(bundle_dir, "checkpoint"), template)
+    if key is None:
+        key = jax.random.PRNGKey(42)
+    start_round = int(np.asarray(state.round))
+
+    if max_rounds is None:
+        if recorded.get("first_bad_round") is not None:
+            max_rounds = recorded["first_bad_round"] - start_round + 1
+        else:
+            max_rounds = 64
+    names = _layer_names(sim)
+
+    verdict: dict = {"first_bad_round": None, "trip": None, "leaf": None,
+                     "leaf_index": None, "nodes": [],
+                     "nonfinite_per_leaf": None, "phase": None,
+                     "start_round": start_round, "matches_recorded": None}
+    for j in range(max_rounds):
+        prev = state
+        state, report = sim.start(state, n_rounds=1, key=key,
+                                  donate_state=False)
+        if _first_trip_index(report) is None:
+            continue
+        verdict["first_bad_round"] = start_round + j
+        counts = np.asarray(nonfinite_counts(state.model.params))
+        verdict["nonfinite_per_leaf"] = [int(c) for c in counts]
+        if counts.sum() > 0:
+            verdict["trip"] = "nonfinite"
+            li = int(np.nonzero(counts > 0)[0][0])
+            verdict["leaf_index"] = li
+            verdict["leaf"] = (names[li] if names and li < len(names)
+                               else str(li))
+            leaf = jax.tree_util.tree_leaves(state.model.params)[li]
+            rows = np.asarray(
+                ~np.isfinite(np.asarray(leaf, np.float32).reshape(
+                    leaf.shape[0], -1))).any(axis=1)
+            verdict["nodes"] = [int(i) for i in np.nonzero(rows)[0][:32]]
+            if localize:
+                verdict["phase"] = localize_first_nonfinite(
+                    sim, prev, key)["phase"]
+        else:
+            verdict["trip"] = "divergence"
+            flags = np.asarray(report.health_diverged_per_node[0])
+            verdict["nodes"] = [int(i) for i in np.nonzero(flags > 0)[0][:32]]
+        break
+    if recorded.get("first_bad_round") is not None:
+        verdict["matches_recorded"] = (
+            verdict["first_bad_round"] == recorded["first_bad_round"])
+    return verdict

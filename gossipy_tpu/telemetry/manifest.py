@@ -110,6 +110,12 @@ def _config_snapshot(sim: Any) -> dict:
         # gossip-dynamics probes this run's report/event stream carries.
         probes = sim.probes
         snap["probes"] = probes.to_dict() if probes is not None else None
+    if hasattr(sim, "sentinels"):
+        # The active SentinelConfig (telemetry.health) or None: which
+        # numerics sentinels this run computed in-graph.
+        sentinels = sim.sentinels
+        snap["sentinels"] = (sentinels.to_dict()
+                             if sentinels is not None else None)
     return snap
 
 
@@ -137,6 +143,7 @@ class RunManifest:
     mesh: Optional[dict] = None
     compile_seconds: Optional[float] = None
     compilation_cache: Optional[dict] = None
+    telemetry_sink: Optional[dict] = None
     created_at: float = field(default_factory=time.time)
     extra: dict = field(default_factory=dict)
     schema: int = MANIFEST_SCHEMA
@@ -165,6 +172,14 @@ class RunManifest:
             cache_stats = compilation_cache_stats()
         except Exception:
             cache_stats = None
+        try:
+            from .sink import get_sink
+            sink = get_sink()
+            sink_stats = {"events_in_ring": len(sink.events()),
+                          "dropped_events": sink.dropped_events,
+                          "maxlen": sink.maxlen}
+        except Exception:
+            sink_stats = None
         return cls(
             config=_config_snapshot(sim),
             backend=_backend_info(),
@@ -174,6 +189,7 @@ class RunManifest:
             mesh=_mesh_info(sim),
             compile_seconds=compile_seconds,
             compilation_cache=cache_stats,
+            telemetry_sink=sink_stats,
             extra=dict(extra or {}),
         )
 
@@ -189,6 +205,7 @@ class RunManifest:
             "mesh": self.mesh,
             "compile_seconds": self.compile_seconds,
             "compilation_cache": self.compilation_cache,
+            "telemetry_sink": self.telemetry_sink,
         }
         if self.extra:
             out["extra"] = self.extra

@@ -42,18 +42,27 @@ class TelemetryEvent:
 class TelemetrySink:
     """Bounded in-memory event ring with an optional JSONL mirror.
 
-    ``maxlen`` bounds host memory (old events fall off the front);
+    ``maxlen`` bounds host memory (old events fall off the front — the
+    ring counts every silent eviction in :attr:`dropped_events`, so
+    consumers of the tail, like the flight recorder's trailing-round
+    window, can tell a short history from a truncated one);
     ``jsonl_path`` appends every event as one JSON line the moment it is
     emitted (line-buffered, so a crashed run keeps its events).
     """
 
     def __init__(self, maxlen: int = 1024,
                  jsonl_path: Optional[str] = None):
+        self.maxlen = int(maxlen)
         self._events: deque = deque(maxlen=maxlen)
         self._fh = open(jsonl_path, "a", buffering=1) if jsonl_path else None
+        self.dropped_events: int = 0
 
     def emit(self, kind: str, data: dict) -> TelemetryEvent:
         ev = TelemetryEvent(kind=kind, data=dict(data))
+        if len(self._events) == self.maxlen:
+            # deque(maxlen=) silently evicts the oldest on append; count
+            # the loss so ring consumers know the head is gone.
+            self.dropped_events += 1
         self._events.append(ev)
         if self._fh is not None:
             self._fh.write(json.dumps(ev.to_dict()) + "\n")
@@ -67,7 +76,15 @@ class TelemetrySink:
         self._events.clear()
 
     def close(self) -> None:
+        """Close the JSONL mirror. When the ring evicted events, a final
+        ``sink_closed`` line records the loss in the mirror (the
+        in-memory tail cannot carry what it already dropped)."""
         if self._fh is not None:
+            if self.dropped_events:
+                self._fh.write(json.dumps(TelemetryEvent(
+                    kind="sink_closed",
+                    data={"dropped_events": self.dropped_events,
+                          "maxlen": self.maxlen}).to_dict()) + "\n")
             self._fh.close()
             self._fh = None
 

@@ -1,13 +1,19 @@
 """Produce the CI run's inspectable trace artifacts.
 
-Runs a tiny (seconds on one CPU core) probe-enabled gossip simulation and
-writes, into ``--out DIR``:
+Runs a tiny (seconds on one CPU core) probe- and sentinel-enabled gossip
+simulation under the flight recorder and writes, into ``--out DIR``:
 
 - ``report.json`` — the full :meth:`SimulationReport.save` record (probe
-  arrays included; round-trips through ``SimulationReport.load``),
+  AND health arrays included; round-trips through
+  ``SimulationReport.load``),
 - ``manifest.json`` — the run's :class:`RunManifest` (config, versions,
-  backend, memory budget, probes),
-- ``events.jsonl`` — the schema-v3 per-round JSONL rows.
+  backend, memory budget, probes, sentinels, sink counters),
+- ``events.jsonl`` — the schema-v4 per-round JSONL rows,
+- ``bundle_*/`` — ONLY when the run trips a sentinel or raises: the
+  flight-recorder repro bundle (checkpoint + manifest + verdict +
+  trailing events), which the CI workflow uploads so a red smoke run
+  ships its own forensics. ``scripts/replay_bundle.py --demo <bundle>``
+  replays it.
 
 ``.github/workflows/ci.yml`` uploads the directory on every run, so each
 CI run leaves a machine-readable trace of what the engine computed — not
@@ -31,6 +37,37 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
+def build_smoke_sim(nodes: int = 16, probes: bool = True,
+                    sentinels: bool = True):
+    """The CI smoke configuration, factored out so
+    ``scripts/replay_bundle.py --demo`` can rebuild the IDENTICAL
+    simulator to replay a smoke-run bundle (the replay contract: same
+    config, same data, same topology seed)."""
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+        Topology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    rng = np.random.default_rng(42)
+    d = 12
+    X = rng.normal(size=(20 * nodes, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
+    disp = DataDispatcher(dh, n=nodes, eval_on_user=False)
+    handler = SGDHandler(
+        model=LogisticRegression(d, 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8, n_classes=2,
+        input_shape=(d,), create_model_mode=CreateModelMode.MERGE_UPDATE)
+    return GossipSimulator(
+        handler, Topology.random_regular(nodes, 4, seed=42),
+        disp.stacked(), delta=20, protocol=AntiEntropyProtocol.PUSH,
+        probes=probes, sentinels=sentinels)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="ci-artifacts",
@@ -41,42 +78,34 @@ def main() -> None:
     os.makedirs(args.out, exist_ok=True)
 
     import jax
-    import optax
 
-    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
-        Topology
-    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
-    from gossipy_tpu.handlers import SGDHandler, losses
-    from gossipy_tpu.models import LogisticRegression
-    from gossipy_tpu.simulation import GossipSimulator, JSONLinesReceiver
+    from gossipy_tpu.simulation import JSONLinesReceiver
     from gossipy_tpu.simulation.report import SimulationReport
+    from gossipy_tpu.telemetry import FlightRecorder
 
-    rng = np.random.default_rng(42)
-    d = 12
-    X = rng.normal(size=(20 * args.nodes, d)).astype(np.float32)
-    y = (X @ rng.normal(size=d) > 0).astype(np.int64)
-    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=42)
-    disp = DataDispatcher(dh, n=args.nodes, eval_on_user=False)
-    handler = SGDHandler(
-        model=LogisticRegression(d, 2), loss=losses.cross_entropy,
-        optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8, n_classes=2,
-        input_shape=(d,), create_model_mode=CreateModelMode.MERGE_UPDATE)
-    sim = GossipSimulator(
-        handler, Topology.random_regular(args.nodes, 4, seed=42),
-        disp.stacked(), delta=20, protocol=AntiEntropyProtocol.PUSH,
-        probes=True)
+    sim = build_smoke_sim(args.nodes)
 
     key = jax.random.PRNGKey(42)
     state = sim.init_nodes(key)
     jsonl_path = os.path.join(args.out, "events.jsonl")
+    recorder = FlightRecorder(args.out, chunk=args.rounds)
     with JSONLinesReceiver(jsonl_path) as rx:
         sim.add_receiver(rx)
-        state, report = sim.start(state, n_rounds=args.rounds, key=key)
+        state, reports, bundle = recorder.run(sim, state,
+                                              n_rounds=args.rounds, key=key)
+    report = reports[0] if len(reports) == 1 else \
+        SimulationReport.concatenate(reports)
 
     report_path = report.save(os.path.join(args.out, "report.json"))
     manifest_path = sim.run_manifest(
         extra={"ci_smoke": True}).save(os.path.join(args.out,
                                                     "manifest.json"))
+    if bundle is not None:
+        # A tripped smoke run still writes every artifact, then fails
+        # loudly — the workflow uploads the bundle for replay.
+        print(f"[ci-smoke] SENTINEL TRIPPED — flight-recorder bundle at "
+              f"{bundle}", file=sys.stderr)
+        sys.exit(2)
 
     # Consistency gates: the artifacts must actually round-trip.
     loaded = SimulationReport.load(report_path)
@@ -85,14 +114,24 @@ def main() -> None:
     hist_sums = report.probe_stale_hist.sum(axis=1)
     accepted = report.probe_accepted_per_node.sum(axis=1)
     assert np.array_equal(hist_sums, accepted), (hist_sums, accepted)
+    # Health block: a healthy smoke run is provably clean end to end.
+    assert np.array_equal(loaded.health_trip, report.health_trip)
+    assert (report.health_trip == 0).all(), report.health_trip
+    assert int(report.health_nonfinite_params.sum()) == 0
+    assert (report.health_first_bad_slot == -1).all()
+    assert np.isfinite(report.health_delta_norm).all()
+    assert report.health_layer_names == loaded.health_layer_names
     rows = [JSONLinesReceiver.parse_line(l) for l in open(jsonl_path)]
     assert len(rows) == args.rounds
     assert all(r["probes"] is not None for r in rows)
+    assert all(r["health"] is not None for r in rows)
+    assert all(r["health"]["trip"] is False for r in rows)
     manifest = json.load(open(manifest_path))
     assert manifest["config"]["probes"] is not None
+    assert manifest["config"]["sentinels"] is not None
     print(f"[ci-smoke] wrote {report_path}, {manifest_path}, {jsonl_path} "
           f"({args.rounds} rounds, {args.nodes} nodes, "
-          f"{int(accepted.sum())} accepted merges)")
+          f"{int(accepted.sum())} accepted merges, 0 sentinel trips)")
 
 
 if __name__ == "__main__":

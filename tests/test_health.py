@@ -1,0 +1,624 @@
+"""Numerics sentinels + flight recorder + deterministic replay.
+
+Covers the ISSUE-4 acceptance criteria:
+
+- ``sentinels=None`` traces the identical program (HLO-equality, the
+  probes-test pattern) and enabling sentinels does not perturb the
+  simulated trajectory;
+- a healthy run's health block is provably clean (zero non-finite
+  counts, zero trips, clean slots);
+- the full failure path: seeded NaN injection trips the sentinel, the
+  flight recorder emits a bundle, and ``replay_bundle`` reproduces the
+  same first-divergent round and leaf deterministically on CPU;
+- exception and watchdog bundles;
+- jitted-vs-sequential health parity;
+- the report registry round trip for every health array, JSONL schema
+  v4 with a version-tolerant reader, ``update_health`` replay/live
+  agreement, the ``CallbackReceiver`` satellite, and the telemetry
+  sink's ``dropped_events`` counter.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+    Topology, uniform_mixing
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, WeightedSGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import (
+    All2AllGossipSimulator,
+    CallbackReceiver,
+    GossipSimulator,
+    JSONLinesReceiver,
+    SequentialGossipSimulator,
+    SimulationEventReceiver,
+)
+from gossipy_tpu.simulation.report import PER_ROUND_FIELDS, SimulationReport
+from gossipy_tpu.telemetry import (
+    FlightRecorder,
+    HealthCarry,
+    SentinelConfig,
+    TelemetrySink,
+    get_sink,
+    replay_bundle,
+    set_sink,
+)
+from gossipy_tpu.telemetry.health import (
+    health_event_row,
+    health_round_stats,
+    nonfinite_counts,
+    nonfinite_total,
+    per_node_param_norm,
+)
+
+N, D = 16, 6
+
+
+def make_data(seed=0, n_samples=320):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_samples, D)).astype(np.float32)
+    y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+    return X, y
+
+
+def make_handler(lr=0.1):
+    return SGDHandler(model=LogisticRegression(D, 2),
+                      loss=losses.cross_entropy, optimizer=optax.sgd(lr),
+                      local_epochs=1, batch_size=8, n_classes=2,
+                      input_shape=(D,),
+                      create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+
+def make_stacked(n=N, poison_node=None):
+    X, y = make_data()
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=n, eval_on_user=False)
+    data = dict(disp.stacked())
+    if poison_node is not None:
+        xtr = np.asarray(data["xtr"]).copy()
+        xtr[poison_node] = np.nan  # the seeded NaN injection
+        data["xtr"] = xtr
+    return data
+
+
+def make_sim(cls=GossipSimulator, lr=0.1, topo=None, n=N, poison_node=None,
+             **kwargs):
+    topo = topo or Topology.random_regular(n, 4, seed=3)
+    return cls(make_handler(lr), topo, make_stacked(n, poison_node),
+               delta=20,
+               protocol=kwargs.pop("protocol", AntiEntropyProtocol.PUSH),
+               **kwargs)
+
+
+def run(sim, rounds=5, key=None, **init_kw):
+    key = key if key is not None else jax.random.PRNGKey(0)
+    st = sim.init_nodes(key, **init_kw)
+    return sim.start(st, n_rounds=rounds, key=key)[1]
+
+
+class TestSentinelConfig:
+    def test_coerce(self):
+        assert SentinelConfig.coerce(None) is None
+        assert SentinelConfig.coerce(False) is None
+        assert SentinelConfig.coerce(True) == SentinelConfig()
+        cfg = SentinelConfig(divergence=False)
+        assert SentinelConfig.coerce(cfg) is cfg
+        assert SentinelConfig.coerce(SentinelConfig(
+            nonfinite=False, divergence=False, saturation=False)) is None
+        with pytest.raises(TypeError):
+            SentinelConfig.coerce("nonfinite")
+        with pytest.raises(ValueError):
+            SentinelConfig(ema_alpha=0.0)
+        with pytest.raises(ValueError):
+            SentinelConfig(divergence_factor=1.0)
+
+
+class TestPureMath:
+    def test_nonfinite_counts_and_total(self):
+        tree = {"a": jnp.array([[1.0, np.nan], [np.inf, 2.0]]),
+                "b": jnp.arange(3)}  # int leaf: always finite
+        np.testing.assert_array_equal(np.asarray(nonfinite_counts(tree)),
+                                      [2, 0])
+        assert int(nonfinite_total(tree)) == 2
+
+    def test_per_node_param_norm(self):
+        params = {"w": jnp.array([[3.0, 4.0], [0.0, 0.0]])}
+        np.testing.assert_allclose(np.asarray(per_node_param_norm(params)),
+                                   [5.0, 0.0])
+
+    def test_divergence_flags_and_ema_guard(self):
+        cfg = SentinelConfig(nonfinite=False, saturation=False,
+                             divergence_factor=10.0, ema_alpha=0.5)
+        hc = HealthCarry.zeros(2)
+        p0 = {"w": jnp.ones((2, 3))}
+        # Round 1 seeds the EMA: no flags however large the norms.
+        hc, s1 = health_round_stats(cfg, hc, p0, p0, None, None)
+        assert int(s1["health_diverged_per_node"].sum()) == 0
+        assert int(s1["health_trip"]) == 0
+        # Round 2: node 0 jumps 100x -> flagged; node 1 stays put.
+        p1 = {"w": jnp.ones((2, 3)).at[0].mul(100.0)}
+        hc, s2 = health_round_stats(cfg, hc, p0, p1, None, None)
+        np.testing.assert_array_equal(
+            np.asarray(s2["health_diverged_per_node"]), [1, 0])
+        assert int(s2["health_trip"]) == 1
+        # A non-finite norm must not poison the EMA baseline.
+        p_nan = {"w": jnp.full((2, 3), jnp.nan)}
+        ema_before = np.asarray(hc.norm_ema)
+        hc, _ = health_round_stats(cfg, hc, p1, p_nan, None, None)
+        np.testing.assert_array_equal(np.asarray(hc.norm_ema), ema_before)
+
+    def test_skipped_eval_rows_do_not_count(self):
+        cfg = SentinelConfig(divergence=False, saturation=False)
+        hc = HealthCarry.zeros(2)
+        p = {"w": jnp.ones((2, 3))}
+        skipped = jnp.full((3,), jnp.nan)  # eval_every skip marker
+        _, s = health_round_stats(cfg, hc, p, p, skipped, skipped)
+        assert int(s["health_nonfinite_metrics"]) == 0
+        genuine = jnp.array([0.5, jnp.nan, 1.0])  # partial NaN = genuine
+        _, s = health_round_stats(cfg, HealthCarry.zeros(2), p, p,
+                                  genuine, skipped)
+        assert int(s["health_nonfinite_metrics"]) == 1
+
+    def test_health_event_row_subsets(self):
+        assert health_event_row({}) is None
+        row = health_event_row({
+            "health_nonfinite_params": np.array([2, 0]),
+            "health_nonfinite_delta": np.array([0, 0]),
+            "health_nonfinite_metrics": np.int32(0),
+            "health_trip": np.int32(1)})
+        assert row["nonfinite_params"] == 2 and row["trip"] is True
+        assert "diverged" not in row
+
+
+class TestSentinelsOffIsUntouched:
+    def test_default_report_has_no_health_fields(self):
+        rep = run(make_sim())
+        for name in PER_ROUND_FIELDS:
+            if name.startswith("health_"):
+                assert getattr(rep, name) is None, name
+        assert rep.health_layer_names is None
+        assert rep.to_dict()["health_trip"] is None
+
+    def test_sentinels_do_not_perturb_the_trajectory(self):
+        rep_off = run(make_sim())
+        rep_on = run(make_sim(sentinels=True))
+        np.testing.assert_array_equal(rep_off.sent_per_round,
+                                      rep_on.sent_per_round)
+        np.testing.assert_array_equal(np.asarray(rep_off._global),
+                                      np.asarray(rep_on._global))
+
+    def test_sentinels_off_hlo_identical(self):
+        """The sentinels=None trace is the same program as one built
+        without the argument at all (every addition is behind the
+        trace-time gate) — the ISSUE-4 acceptance criterion."""
+        sim_default = make_sim()
+        sim_off = make_sim(sentinels=None)
+        key = jax.random.PRNGKey(0)
+        st = sim_default.init_nodes(key)
+        hlo_a = sim_default.lower_start(st, n_rounds=2, key=key).as_text()
+        hlo_b = sim_off.lower_start(st, n_rounds=2, key=key).as_text()
+        assert hlo_a == hlo_b
+
+    def test_all2all_sentinels_off_hlo_identical(self):
+        def build(**kw):
+            topo = Topology.random_regular(N, 4, seed=3)
+            handler = WeightedSGDHandler(
+                model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+                optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8,
+                n_classes=2, input_shape=(D,),
+                create_model_mode=CreateModelMode.MERGE_UPDATE)
+            return All2AllGossipSimulator(handler, topo, make_stacked(),
+                                          delta=20,
+                                          mixing=uniform_mixing(topo), **kw)
+        key = jax.random.PRNGKey(0)
+        sim_a, sim_b = build(), build(sentinels=None)
+        st = sim_a.init_nodes(key)
+        assert sim_a.lower_start(st, n_rounds=2, key=key).as_text() == \
+            sim_b.lower_start(st, n_rounds=2, key=key).as_text()
+
+
+class TestHealthyRunVitals:
+    def test_clean_run_is_provably_clean(self):
+        rep = run(make_sim(sentinels=True), rounds=6)
+        assert (rep.health_trip == 0).all()
+        assert int(rep.health_nonfinite_params.sum()) == 0
+        assert int(rep.health_nonfinite_delta.sum()) == 0
+        assert (rep.health_nonfinite_metrics == 0).all()
+        assert (rep.health_first_bad_slot == -1).all()
+        assert int(rep.health_diverged_per_node.sum()) == 0
+        assert np.isfinite(rep.health_delta_norm).all()
+        # The high-water mark is the running max of the delta norms.
+        np.testing.assert_allclose(rep.health_delta_hwm,
+                                   np.maximum.accumulate(
+                                       rep.health_delta_norm), rtol=1e-6)
+        # Saturation watermark: monotone, bounded by the mailbox size.
+        hwm = rep.health_mailbox_hwm_run
+        assert (np.diff(hwm) >= 0).all()
+        assert hwm[-1] == rep.mailbox_hwm_per_round.max()
+        assert len(rep.health_layer_names) == \
+            rep.health_nonfinite_params.shape[1]
+
+    def test_subset_config_only_emits_its_fields(self):
+        rep = run(make_sim(sentinels=SentinelConfig(divergence=False,
+                                                    saturation=False)))
+        assert rep.health_nonfinite_params is not None
+        assert rep.health_diverged_per_node is None
+        assert rep.health_mailbox_hwm_run is None
+        assert rep.health_trip is not None
+
+    def test_divergence_flags_fire_on_host_injected_jump(self):
+        """A node whose params jump 1000x mid-run trips the divergence
+        sentinel on the next round."""
+        sim = make_sim(sentinels=True)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        st, _ = sim.start(st, n_rounds=3, key=key, donate_state=False)
+        boosted = jax.tree.map(
+            lambda l: jnp.asarray(np.asarray(l) * np.where(
+                np.arange(l.shape[0]).reshape((-1,) + (1,) * (l.ndim - 1))
+                == 5, 1000.0, 1.0), l.dtype),
+            st.model.params)
+        st = st._replace(model=st.model._replace(params=boosted))
+        st, rep = sim.start(st, n_rounds=2, key=key)
+        assert rep.health_diverged_per_node[0, 5] == 1
+        assert rep.health_trip[0] == 1
+
+    def test_run_repetitions_carries_health_per_seed(self):
+        sim = make_sim(sentinels=True)
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        _, reports = sim.run_repetitions(4, keys)
+        assert len(reports) == 3
+        for rep in reports:
+            assert rep.health_trip.shape == (4,)
+            assert (rep.health_trip == 0).all()
+
+    def test_all2all_health_block(self):
+        topo = Topology.random_regular(N, 4, seed=3)
+        handler = WeightedSGDHandler(
+            model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(D,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        sim = All2AllGossipSimulator(handler, topo, make_stacked(),
+                                     delta=20, mixing=uniform_mixing(topo),
+                                     sentinels=True)
+        rep = run(sim, rounds=4)
+        assert (rep.health_trip == 0).all()
+        assert int(rep.health_nonfinite_params.sum()) == 0
+        # The All2All branch vital: effective mixing weights all finite.
+        assert (rep.health_mix_nonfinite == 0).all()
+        # No mailbox slot loop in this round shape.
+        assert rep.health_first_bad_slot is None
+
+    def test_manifest_records_sentinel_config(self):
+        sim_on = make_sim(sentinels=SentinelConfig(divergence_factor=7.0))
+        sim_off = make_sim()
+        d = sim_on.run_manifest().to_dict()
+        assert d["config"]["sentinels"]["divergence_factor"] == 7.0
+        assert sim_off.run_manifest().to_dict()["config"]["sentinels"] \
+            is None
+
+
+class TestReportAndEvents:
+    def test_health_arrays_round_trip_and_concatenate(self, tmp_path):
+        rep = run(make_sim(sentinels=True), rounds=4)
+        path = str(tmp_path / "report.json")
+        rep.save(path)
+        loaded = SimulationReport.load(path)
+        for name in PER_ROUND_FIELDS:
+            if not name.startswith("health_"):
+                continue
+            v = getattr(rep, name)
+            if v is None:
+                assert getattr(loaded, name) is None, name
+                continue
+            np.testing.assert_allclose(
+                np.asarray(getattr(loaded, name), np.float64),
+                np.asarray(v, np.float64), atol=1e-6, err_msg=name)
+        assert loaded.health_layer_names == rep.health_layer_names
+        cat = SimulationReport.concatenate([loaded, loaded])
+        assert cat.health_trip.shape == (8,)
+        assert cat.health_nonfinite_params.shape[0] == 8
+        assert cat.health_layer_names == rep.health_layer_names
+
+    def test_update_health_replay_and_live_agree(self):
+        class Recorder(SimulationEventReceiver):
+            def __init__(self, live=False):
+                self.live = live
+                self.rows = []
+
+            def update_health(self, round, health):
+                self.rows.append((round, health))
+
+        def go(live):
+            sim = make_sim(sentinels=True)
+            rec = Recorder(live=live)
+            sim.add_receiver(rec)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=3, key=key)
+            return rec.rows
+
+        replay, live = go(False), go(True)
+        assert [r for r, _ in replay] == [1, 2, 3]
+        assert replay == live
+        for _, row in replay:
+            assert row["trip"] is False
+            assert row["nonfinite_params"] == 0
+            assert "delta_norm" in row and "mailbox_hwm_run" in row
+
+    def test_jsonl_v4_rows_and_reader(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sim = make_sim(sentinels=True)
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rx)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=3, key=key)
+        rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
+        assert all(r["schema"] == 4 for r in rows)
+        assert all(r["health"] is not None for r in rows)
+        assert all(r["health"]["trip"] is False for r in rows)
+        assert all(r["probes"] is None for r in rows)  # probes off here
+        # A v3 line normalizes: health comes back null.
+        v3 = json.dumps({"schema": 3, "round": 1, "sent": 2, "failed": 0,
+                         "failed_by_cause": None, "probes": None,
+                         "size": 9, "local": None, "global": None})
+        assert JSONLinesReceiver.parse_line(v3)["health"] is None
+
+    def test_callback_receiver_forwards_flat_rows(self):
+        rows = []
+        sim = make_sim(sentinels=True, probes=True)
+        sim.add_receiver(CallbackReceiver(rows.append))
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=3, key=key)
+        assert [r["round"] for r in rows] == [1, 2, 3]
+        for r in rows:
+            assert set(r) >= {"round", "sent", "failed", "size",
+                              "failed_by_cause", "probes", "health",
+                              "global"}
+            assert r["health"]["trip"] is False
+            assert r["probes"]["accepted_total"] >= 0
+
+    def test_callback_receiver_live_matches_replay(self):
+        def go(live):
+            rows = []
+            sim = make_sim(sentinels=True)
+            sim.add_receiver(CallbackReceiver(rows.append, live=live))
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=2, key=key)
+            return rows
+        assert go(False) == go(True)
+
+
+class TestSinkDroppedEvents:
+    def test_ring_counts_evictions(self):
+        sink = TelemetrySink(maxlen=4)
+        for i in range(7):
+            sink.emit("k", {"i": i})
+        assert sink.dropped_events == 3
+        assert len(sink.events()) == 4
+        assert sink.events()[0].data["i"] == 3  # oldest three evicted
+
+    def test_close_records_loss_in_mirror(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        sink = TelemetrySink(maxlen=2, jsonl_path=path)
+        for i in range(5):
+            sink.emit("k", {"i": i})
+        sink.close()
+        lines = [json.loads(l) for l in open(path)]
+        # The mirror keeps every line; the final one records the ring loss.
+        assert len(lines) == 6
+        assert lines[-1]["kind"] == "sink_closed"
+        assert lines[-1]["data"]["dropped_events"] == 3
+
+    def test_manifest_surfaces_sink_counters(self):
+        prev = set_sink(TelemetrySink(maxlen=2))
+        try:
+            for i in range(5):
+                get_sink().emit("k", {"i": i})
+            d = make_sim().run_manifest().to_dict()
+            assert d["telemetry_sink"]["dropped_events"] == 3
+            assert d["telemetry_sink"]["maxlen"] == 2
+        finally:
+            set_sink(prev)
+
+
+class TestFlightRecorderAndReplay:
+    """The ISSUE-4 end-to-end forensics proof: NaN-injection run ->
+    bundle on disk -> replay names the same first-divergent round and
+    leaf deterministically."""
+
+    POISON = 3
+
+    def _sim(self):
+        return make_sim(sentinels=True, poison_node=self.POISON)
+
+    def test_nan_injection_trips_and_replays_bit_for_bit(self, tmp_path):
+        sim = self._sim()
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key, local_train=False)
+        rec = FlightRecorder(str(tmp_path), chunk=2)
+        st, reports, bundle = rec.run(sim, st, n_rounds=8, key=key)
+        assert bundle is not None and os.path.isdir(bundle)
+        verdict = json.load(open(os.path.join(bundle, "verdict.json")))
+        assert verdict["kind"] == "sentinel"
+        assert verdict["first_bad_round"] is not None
+        assert verdict["detail"]["nonfinite_params_total"] > 0
+        # The bundle is self-describing: manifest + events + checkpoint.
+        assert os.path.exists(os.path.join(bundle, "manifest.json"))
+        assert os.path.exists(os.path.join(bundle, "events.jsonl"))
+        from gossipy_tpu.checkpoint import load_checkpoint_meta
+        meta = load_checkpoint_meta(os.path.join(bundle, "checkpoint"))
+        assert meta["round"] == verdict["chunk_start_round"]
+
+        # Replay through a FRESH simulator (same config): same first
+        # divergent round, a named leaf, the poisoned node implicated.
+        replayed = replay_bundle(bundle, self._sim())
+        assert replayed["matches_recorded"] is True
+        assert replayed["first_bad_round"] == verdict["first_bad_round"]
+        assert replayed["trip"] == "nonfinite"
+        assert replayed["leaf"] in sim._probe_layer_names()
+        assert self.POISON in replayed["nodes"]
+        assert replayed["phase"] in ("send", "receive_merge", "reply")
+
+        # Determinism (bit-for-bit on CPU): a second replay produces the
+        # identical verdict.
+        again = replay_bundle(bundle, self._sim())
+        assert again == replayed
+
+    def test_exception_writes_bundle_then_reraises(self, tmp_path):
+        sim = make_sim(sentinels=True)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        boom = RuntimeError("chip fell over")
+
+        original = sim.start
+        calls = {"n": 0}
+
+        def flaky_start(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise boom
+            return original(*a, **kw)
+
+        sim.start = flaky_start
+        rec = FlightRecorder(str(tmp_path), chunk=2)
+        with pytest.raises(RuntimeError, match="chip fell over"):
+            rec.run(sim, st, n_rounds=6, key=key)
+        assert rec.bundle_path is not None
+        verdict = json.load(open(os.path.join(rec.bundle_path,
+                                              "verdict.json")))
+        assert verdict["kind"] == "exception"
+        assert "chip fell over" in verdict["detail"]["error"]
+        # The checkpoint is the last HEALTHY chunk boundary (round 2).
+        assert verdict["chunk_start_round"] == 2
+
+    def test_watchdog_fires_on_stalled_chunk(self, tmp_path):
+        import time
+        sim = make_sim(sentinels=True)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        original = sim.start
+
+        def slow_start(*a, **kw):
+            time.sleep(0.6)  # outlives the watchdog deadline
+            return original(*a, **kw)
+
+        sim.start = slow_start
+        rec = FlightRecorder(str(tmp_path), chunk=4,
+                             watchdog_seconds=0.1)
+        st, reports, bundle = rec.run(sim, st, n_rounds=4, key=key)
+        assert bundle is not None
+        verdict = json.load(open(os.path.join(bundle, "verdict.json")))
+        assert verdict["kind"] == "watchdog"
+
+    def test_recorder_requires_sentinels(self, tmp_path):
+        sim = make_sim()
+        with pytest.raises(AssertionError, match="sentinel-enabled"):
+            FlightRecorder(str(tmp_path)).run(
+                sim, sim.init_nodes(jax.random.PRNGKey(0)), 2,
+                jax.random.PRNGKey(0))
+
+    def test_trailing_window_truncation_warns_once(self, tmp_path):
+        prev = set_sink(TelemetrySink(maxlen=3))
+        try:
+            sim = self._sim()
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key, local_train=False)
+            rec = FlightRecorder(str(tmp_path), chunk=4,
+                                 trailing_rounds=16)
+            with pytest.warns(UserWarning, match="trailing window "
+                                                "truncated"):
+                rec.run(sim, st, n_rounds=8, key=key)
+        finally:
+            set_sink(prev)
+
+    def test_replay_cli_with_factory(self, tmp_path):
+        """scripts/replay_bundle.py end to end via a --factory module."""
+        import subprocess
+        import sys
+        sim = self._sim()
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key, local_train=False)
+        rec = FlightRecorder(str(tmp_path / "fr"), chunk=2)
+        _, _, bundle = rec.run(sim, st, n_rounds=8, key=key)
+        assert bundle is not None
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        factory_dir = tmp_path / "mods"
+        factory_dir.mkdir()
+        (factory_dir / "bundle_factory.py").write_text(
+            f"import sys\nsys.path.insert(0, {repo!r})\n"
+            f"sys.path.insert(0, {os.path.dirname(__file__)!r})\n"
+            "from test_health import TestFlightRecorderAndReplay\n"
+            "def build():\n"
+            "    return TestFlightRecorderAndReplay()._sim()\n")
+        env = dict(os.environ,
+                   PYTHONPATH=str(factory_dir), JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts",
+                                          "replay_bundle.py"),
+             bundle, "--factory", "bundle_factory:build"],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, proc.stderr
+        verdict = json.loads(proc.stdout)
+        assert verdict["matches_recorded"] is True
+        assert verdict["trip"] == "nonfinite"
+
+
+class TestSequentialParity:
+    """Jitted-vs-sequential health parity: the clean regime agrees
+    everywhere, and the same seeded NaN injection trips BOTH engines on
+    the first round under PUSH_PULL (every firing node merges the reply
+    it provoked, so the poisoned node provably trains round one)."""
+
+    def _build(self, cls, poison=None, **kw):
+        return make_sim(cls=cls, lr=0.0, topo=Topology.clique(N),
+                        protocol=AntiEntropyProtocol.PUSH_PULL,
+                        sentinels=True, poison_node=poison, **kw)
+
+    def test_clean_parity(self):
+        reps = {}
+        for cls, name in ((GossipSimulator, "jit"),
+                          (SequentialGossipSimulator, "seq")):
+            sim = self._build(cls)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key, local_train=False, common_init=True)
+            reps[name] = sim.start(st, n_rounds=4, key=key)[1]
+        jit, seq = reps["jit"], reps["seq"]
+        # Common init + lr 0: nothing moves, nothing trips — exactly, on
+        # both engines.
+        for rep in (jit, seq):
+            assert (rep.health_trip == 0).all()
+            assert int(rep.health_nonfinite_params.sum()) == 0
+            np.testing.assert_allclose(rep.health_delta_norm,
+                                       np.zeros(4), atol=1e-6)
+        np.testing.assert_array_equal(jit.health_diverged_per_node,
+                                      seq.health_diverged_per_node)
+        assert jit.health_layer_names == seq.health_layer_names
+
+    def test_nan_injection_parity(self):
+        trips = {}
+        for cls, name in ((GossipSimulator, "jit"),
+                          (SequentialGossipSimulator, "seq")):
+            sim = self._build(cls, poison=3)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key, local_train=False, common_init=True)
+            rep = sim.start(st, n_rounds=3, key=key)[1]
+            trips[name] = rep
+        for name, rep in trips.items():
+            assert rep.health_trip[0] == 1, name
+            assert int(rep.health_nonfinite_params[0].sum()) > 0, name
+        # Both engines implicate the same leaves on the first round.
+        np.testing.assert_array_equal(
+            np.asarray(trips["jit"].health_nonfinite_params[0]) > 0,
+            np.asarray(trips["seq"].health_nonfinite_params[0]) > 0)

@@ -413,23 +413,33 @@ class TestEventsAndJSONL:
             st = sim.init_nodes(key)
             sim.start(st, n_rounds=3, key=key)
         rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
-        assert all(r["schema"] == 3 for r in rows)
+        assert all(r["schema"] == JSONLinesReceiver.SCHEMA for r in rows)
         assert all(r["probes"] is not None for r in rows)
         assert all(sum(r["probes"]["stale_hist"])
                    == r["probes"]["accepted_total"] for r in rows)
-        # v1 and v2 lines (as historic writers produced them) normalize to
-        # the v3 shape: predating fields come back None, values intact.
+        # v1..v3 lines (as historic writers produced them) normalize to
+        # the CURRENT shape: predating fields come back None, values
+        # intact.
         v1 = json.dumps({"schema": 1, "round": 7, "sent": 5, "failed": 1,
                          "size": 10, "local": None, "global": None})
         v2 = json.dumps({"schema": 2, "round": 8, "sent": 5, "failed": 1,
                          "failed_by_cause": {"drop": 1, "offline": 0,
                                              "overflow": 0},
                          "size": 10, "local": None, "global": None})
-        r1, r2 = JSONLinesReceiver.parse_line(v1), \
-            JSONLinesReceiver.parse_line(v2)
+        v3 = json.dumps({"schema": 3, "round": 9, "sent": 5, "failed": 1,
+                         "failed_by_cause": None,
+                         "probes": {"consensus_mean": 0.5},
+                         "size": 10, "local": None, "global": None})
+        r1, r2, r3 = (JSONLinesReceiver.parse_line(v)
+                      for v in (v1, v2, v3))
         assert r1["failed_by_cause"] is None and r1["probes"] is None
+        assert r1["health"] is None
         assert r1["round"] == 7 and r1["sent"] == 5
         assert r2["failed_by_cause"]["drop"] == 1 and r2["probes"] is None
+        assert r2["health"] is None
+        # A v3 line predates the health field; its probe row is intact.
+        assert r3["health"] is None
+        assert r3["probes"]["consensus_mean"] == 0.5
         # A hypothetical future line with unknown fields passes through.
         v9 = json.dumps({"schema": 9, "round": 1, "sent": 0, "failed": 0,
                          "failed_by_cause": None, "probes": None,

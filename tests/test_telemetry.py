@@ -210,7 +210,7 @@ class TestReport:
         # allow_nan=False: NaN eval rows must have become nulls.
         text = json.dumps(d, allow_nan=False)
         back = json.loads(text)
-        assert back["schema"] == 3  # v3: + probe arrays (null when off)
+        assert back["schema"] == 4  # v4: + health arrays (null when off)
         assert back["global_evals"][1] == [None]
         assert back["failed_per_cause"]["drop"] == [1, 0, 1]
         path = rep.save(str(tmp_path / "report.json"))
@@ -312,8 +312,13 @@ class TestTelemetrySink:
             sink.emit("k", {"i": i})
         sink.close()
         assert [e.data["i"] for e in sink.events()] == [1, 2]  # ring bound
+        assert sink.dropped_events == 1  # the ring evicted i=0
         rows = [json.loads(l) for l in open(path)]
-        assert [r["data"]["i"] for r in rows] == [0, 1, 2]  # mirror keeps all
+        # The mirror keeps every emitted line, and close() appends one
+        # sink_closed record of the ring's loss.
+        assert [r["data"]["i"] for r in rows[:3]] == [0, 1, 2]
+        assert rows[-1]["kind"] == "sink_closed"
+        assert rows[-1]["data"]["dropped_events"] == 1
 
 
 class Recorder(SimulationEventReceiver):
@@ -389,7 +394,7 @@ class TestReceivers:
         rows = [json.loads(l) for l in open(path)]
         assert len(rows) == 4
         for i, row in enumerate(rows):
-            assert row["schema"] == 3  # v3: + "probes" (null when off)
+            assert row["schema"] == 4  # v4: + "health" (null when off)
             assert set(row["failed_by_cause"]) == set(FAILURE_CAUSES)
             assert sum(row["failed_by_cause"].values()) == row["failed"]
             assert row["failed"] == rep.failed_per_round[i]
