@@ -74,6 +74,14 @@ def emit(payload: dict) -> None:
     raw = payload.setdefault("raw", {})
     raw.setdefault("backend", jax.default_backend())
     raw.setdefault("device_kind", jax.devices()[0].device_kind)
+    try:
+        # Synthetic-data generation version: accuracy-bearing rows from
+        # different generator recipes must not be compared as one regime
+        # (the throughput metrics don't care, the to-accuracy ones do).
+        from gossipy_tpu.data import SYNTHETIC_DATA_VERSION
+        raw.setdefault("data_version", SYNTHETIC_DATA_VERSION)
+    except Exception:
+        pass
     raw["degraded"] = DEGRADED
     if DEGRADED and os.environ.get("GOSSIPY_TPU_DEGRADE_REASON"):
         raw["degrade_reason"] = os.environ["GOSSIPY_TPU_DEGRADE_REASON"]

@@ -90,6 +90,25 @@ def load_checkpoint_meta(path: str) -> Optional[dict]:
         return json.load(fh)
 
 
+def slice_lane(tree: Any, i: int) -> Any:
+    """Extract lane ``i`` of a batched pytree (leading batch axis on every
+    array leaf) as HOST numpy arrays — the bridge from a [T, ...]-stacked
+    seed/tenant-vmapped :class:`SimState` (``run_repetitions`` outputs,
+    the service scheduler's megabatch states) to the solo-shaped state a
+    checkpoint, flight-recorder bundle, or replay template expects.
+
+    Materializing on the host is deliberate: the copy survives a later
+    donation of the batched source (the scheduler donates its state batch
+    to the next chunk while keeping per-tenant last-healthy copies), and
+    :func:`save_checkpoint` accepts numpy leaves directly. Scalar
+    (0-dim) leaves pass through unsliced.
+    """
+    def take(l):
+        a = np.asarray(l)
+        return a[i] if a.ndim else a
+    return jax.tree.map(take, tree)
+
+
 def restore_checkpoint(path: str, template_state: Any,
                        template_key: Optional[jax.Array] = None):
     """Restore ``(state, key)`` from ``path``.

@@ -271,6 +271,17 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
 # The config dataclass
 # --------------------------------------------------------------------------
 
+# Config fields a service tenant may vary WITHOUT changing the compiled
+# round program (gossipy_tpu/service/packer.py buckets runs by the rest):
+# ``seed`` only changes data values / init draws (array shapes are hashed
+# separately by the packer, so a seed that DID change a shape still splits
+# the bucket); ``drop_prob``/``online_prob`` are traced per-tenant scalars
+# in the megabatch program; ``n_rounds``/``repetitions`` are host-side
+# run-length knobs outside the per-round trace.
+TENANT_VARIABLE_FIELDS = ("seed", "drop_prob", "online_prob", "n_rounds",
+                          "repetitions")
+
+
 @dataclasses.dataclass
 class ExperimentConfig:
     """One gossip-learning experiment, declaratively.
@@ -378,6 +389,19 @@ class ExperimentConfig:
             raise ValueError(f"unknown config fields: {sorted(unknown)}; "
                              f"valid fields: {sorted(fields)}")
         return ExperimentConfig(**d)
+
+    def shape_fields(self) -> dict:
+        """The config fields that pin the compiled round program — every
+        field except :data:`TENANT_VARIABLE_FIELDS`. Two configs with
+        equal ``shape_fields()`` build simulators whose round programs
+        trace identically (same model/handler constants, topology,
+        mailbox geometry, probes/sentinels), so the service packer can
+        fuse them into one seed/config-vmapped megabatch; the variable
+        fields ride the batch as data."""
+        d = dataclasses.asdict(self)
+        for f in TENANT_VARIABLE_FIELDS:
+            d.pop(f, None)
+        return d
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,40 @@
+"""Gossip-as-a-service: a multi-tenant run scheduler over one device set.
+
+The "millions of users" axis of the ROADMAP: instead of one process
+driving one simulation, this package multiplexes MANY concurrent
+experiments ("tenants") through three pieces:
+
+- :mod:`.spec` — :class:`RunRequest` (an
+  :class:`~gossipy_tpu.config.ExperimentConfig` + tenant name, JSON spec
+  format), :class:`RunHandle` (status / report / artifacts / bundle) and
+  the :class:`RunQueue`;
+- :mod:`.packer` — buckets queued runs by compiled-program
+  :class:`ShapeSignature` (config shape fields + built-simulator
+  geometry + topology content + data shapes) so same-shape tenants fuse
+  into one seed/config-vmapped megabatch program;
+- :mod:`.scheduler` — :class:`GossipService`, the cooperative host-side
+  control plane: chunked round slices round-robin across buckets, donated
+  state, per-tenant telemetry (JSONL/report/manifest), and sentinel-trip
+  eviction with flight-recorder bundles.
+
+See ``docs/service.md`` for the model and ``scripts/serve.py`` /
+``examples/main_service.py`` for drivers.
+"""
+
+from .packer import (
+    Bucket,
+    BuiltRun,
+    ShapeSignature,
+    build_request,
+    pack,
+    shape_signature,
+)
+from .scheduler import GossipService
+from .spec import RunHandle, RunQueue, RunRequest, RunStatus
+
+__all__ = [
+    "RunRequest", "RunHandle", "RunQueue", "RunStatus",
+    "ShapeSignature", "BuiltRun", "Bucket", "shape_signature",
+    "build_request", "pack",
+    "GossipService",
+]

@@ -1,0 +1,188 @@
+"""Shape-packed megabatching: bucket queued runs by compiled-program shape.
+
+The scheduling currency of the service is the COMPILED PROGRAM (the
+"Scalable Training of Language Models using JAX pjit and TPUv4" lesson:
+compilation is minutes, execution is milliseconds — reuse is everything).
+Two runs can share one program exactly when their round programs trace
+identically; then a single ``vmap`` over a tenant axis executes both in
+one XLA program, the same mechanism ``run_repetitions`` uses for seeds —
+extended here to tenants that also differ in data values and fault rates
+(``drop_prob``/``online_prob`` become traced per-lane scalars).
+
+What must match — the :class:`ShapeSignature` — is everything the trace
+closes over: the config's :meth:`~gossipy_tpu.config.ExperimentConfig.
+shape_fields` (model/handler constants, topology spec, protocol, mailbox
+geometry knobs, probes/sentinels), plus facts only the BUILT simulator
+knows: the derived mailbox slots ``K``, the delay model (which sets the
+history-ring depth ``D``), the history wire format and dtypes, the
+topology's actual adjacency content (two seeds that somehow built
+different graphs must not share a closed-over adjacency), and the stacked
+data array shapes/dtypes. What may differ — and rides the tenant axis as
+data — is the PRNG seed, the data values, the fault rates, and the
+requested round count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import zlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .spec import RunHandle, RunRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeSignature:
+    """A bucket key: the digest plus the human-readable field dict it
+    hashes (stamped into run summaries and per-tenant manifests so
+    cross-tenant program sharing is auditable)."""
+
+    digest: str
+    summary: dict
+
+    def __str__(self) -> str:
+        return self.digest
+
+
+@dataclasses.dataclass
+class BuiltRun:
+    """A request built into a live (but not yet compiled) simulator:
+    the packer's unit of work. ``sim`` is only EXECUTED when this run is
+    its bucket's representative; for co-tenants it exists to prove the
+    signature honest (topology content, derived geometry) and to supply
+    the tenant's stacked data values."""
+
+    request: RunRequest
+    handle: RunHandle
+    sim: Any                 # GossipSimulator (or jitted variant)
+    key: jax.Array           # root PRNG key (set_seed(cfg.seed))
+    signature: ShapeSignature
+
+    @property
+    def tenant(self) -> str:
+        return self.request.tenant
+
+
+def _topology_digest(topology: Any) -> str:
+    """Content hash of the topology's edge structure (dense adjacency or
+    CSR), so two tenants share a program only when the CLOSED-OVER graph
+    is byte-identical — the builder-spec fields alone cannot promise
+    that."""
+    try:
+        adj = topology.adjacency
+    except AttributeError:  # SparseTopology refuses dense materialization
+        adj = None
+    if adj is not None:
+        payload = np.ascontiguousarray(np.asarray(adj, dtype=np.int8))
+    else:
+        payload = np.concatenate([
+            np.asarray(topology.degrees, dtype=np.int64).ravel(),
+            np.asarray(topology.indices, dtype=np.int64).ravel()])
+    return f"{zlib.crc32(payload.tobytes()):08x}"
+
+
+def _data_shapes(data: dict) -> dict:
+    """Stacked-data geometry (``sim.data`` holds jnp arrays)."""
+    return {k: [list(v.shape), str(v.dtype)]
+            for k, v in sorted(data.items())}
+
+
+def shape_signature(request: RunRequest, sim: Any) -> ShapeSignature:
+    """The megabatch bucket key for a built run (see module doc for what
+    it covers). Built-simulator facts are included on top of the config's
+    ``shape_fields()`` because several trace constants are DERIVED at
+    construction (mailbox slots from the topology's fan-in, metric names
+    from the handler) and a config-only key could lie."""
+    fields = {
+        "config": request.config.shape_fields(),
+        "simulator_class": type(sim).__name__,
+        "n_nodes": sim.n_nodes,
+        "mailbox_slots": sim.K,
+        "reply_slots": sim.Kr,
+        "max_fires_per_round": sim.F,
+        "history_dtype": sim.history_dtype,
+        "fused_merge": sim.fused_merge,
+        "delay": repr(sim.delay),
+        "probes": sim.probes.to_dict() if sim.probes is not None else None,
+        "sentinels": (sim.sentinels.to_dict()
+                      if sim.sentinels is not None else None),
+        "topology": _topology_digest(sim.topology),
+        "data_shapes": _data_shapes(sim.data),
+    }
+    digest = hashlib.sha1(
+        json.dumps(fields, sort_keys=True, default=str).encode()
+    ).hexdigest()[:12]
+    return ShapeSignature(digest=digest, summary=fields)
+
+
+def build_request(request: RunRequest, handle: Optional[RunHandle] = None,
+                  sentinels_default: bool = True) -> BuiltRun:
+    """Build a request into a :class:`BuiltRun`: seed the host RNGs the
+    way ``run_experiment`` does (so a tenant's megabatch trajectory is
+    the one its solo run would produce), build the simulator + stacked
+    data, and compute the shape signature.
+
+    ``sentinels_default=True`` injects ``sentinels=True`` into the
+    simulator unless the config says otherwise — eviction-on-trip (the
+    service's failure isolation) needs the in-graph ``health_trip`` flag.
+    The injection happens on a config COPY and is part of the signature,
+    so explicitly-configured tenants bucket apart, as they must.
+    """
+    from .. import set_seed
+    from ..config import build_experiment
+
+    cfg = request.config
+    if sentinels_default and "sentinels" not in cfg.simulator_params:
+        cfg = dataclasses.replace(
+            cfg, simulator_params={**cfg.simulator_params,
+                                   "sentinels": True})
+        request = dataclasses.replace(request, config=cfg)
+    key = set_seed(cfg.seed)
+    sim, _ = build_experiment(cfg, request.data)
+    if handle is None:
+        handle = RunHandle(request=request)
+    else:
+        handle.request = request
+    sig = shape_signature(request, sim)
+    handle.bucket = sig.digest
+    return BuiltRun(request=request, handle=handle, sim=sim, key=key,
+                    signature=sig)
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One megabatch: every run in it shares one compiled init program
+    and one compiled step program; the tenant axis is the vmap axis."""
+
+    signature: ShapeSignature
+    runs: list
+
+    @property
+    def size(self) -> int:
+        return len(self.runs)
+
+    @property
+    def tenants(self) -> list:
+        return [r.tenant for r in self.runs]
+
+
+def pack(built: list) -> list:
+    """Group built runs into buckets by shape signature, preserving
+    first-seen order (the scheduler round-robins buckets in this order).
+    Identical signatures fuse; ANY divergence — population, model,
+    mailbox geometry, dtypes, probes/sentinels config, topology content,
+    data shapes — splits."""
+    by_sig: dict[str, Bucket] = {}
+    order: list[str] = []
+    for run in built:
+        d = run.signature.digest
+        if d not in by_sig:
+            by_sig[d] = Bucket(signature=run.signature, runs=[])
+            order.append(d)
+        by_sig[d].runs.append(run)
+    return [by_sig[d] for d in order]

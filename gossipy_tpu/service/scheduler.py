@@ -1,0 +1,526 @@
+"""Cooperative multi-tenant scheduler: many experiments, one device set.
+
+The Podracer-architecture split, applied to gossip simulation: round
+execution is actor-like on-device work (one vmapped megabatch program per
+bucket, tenants riding the batch axis), while admission, slicing,
+telemetry routing and failure handling live in a host-side control plane
+— this module. The scheduler:
+
+- **packs** queued runs into shape buckets (:mod:`.packer`) and compiles
+  ONE init program + ONE step program per bucket, whatever the tenant
+  count — the compiled program is the scheduling currency, shared further
+  across processes via the persistent compilation cache
+  (``GOSSIPY_TPU_COMPILATION_CACHE``);
+- **drives** buckets cooperatively in chunked round slices (round-robin
+  across buckets, state donated between slices so the [T, D, N, ...]
+  history rings are never double-buffered);
+- **streams** per-tenant telemetry: each tenant gets its own JSONL event
+  stream (schema-v4 rows replayed per slice), its own
+  :class:`~gossipy_tpu.simulation.report.SimulationReport` and its own
+  per-tenant :class:`~gossipy_tpu.telemetry.RunManifest` (fault
+  rates/seed patched to the TENANT's values, bucket + signature + the
+  bucket's compilation-cache delta stamped into ``extra.service``);
+- **survives tenant failure**: each slice's start states are kept as
+  host-side last-healthy copies; when a tenant's in-graph ``health_trip``
+  sentinel fires, the scheduler writes that tenant's flight-recorder
+  repro bundle (:meth:`~gossipy_tpu.telemetry.FlightRecorder.
+  write_bundle` from its last healthy lane state) and EVICTS the tenant —
+  its handle reports ``EVICTED`` with a truncated report — while
+  co-tenants in the same bucket keep running untouched (vmapped lanes are
+  independent; the tripped lane's numbers are simply no longer read).
+
+Chunk-boundary note: like every chunked driver (``CheckpointManager``,
+``FlightRecorder``), a slice's final round counts as a segment-final
+round, which under ``eval_every > 1`` evaluates where one continuous scan
+would skip — tenant curves can carry those extra eval rows.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import compilation_cache_stats
+from ..checkpoint import slice_lane
+from ..simulation.engine import BATCH_AXIS
+from ..simulation.events import JSONLinesReceiver, SimulationEventSender
+from ..telemetry import RunManifest, emit_event
+from ..telemetry.health import FlightRecorder
+from .packer import Bucket, BuiltRun, build_request, pack
+from .spec import RunQueue, RunRequest, RunStatus
+
+
+class _TenantSender(SimulationEventSender):
+    """Per-tenant receiver host: the megabatch program cannot run live
+    io_callbacks per tenant, so each slice's recorded rows are replayed
+    through this sender to the tenant's receivers (JSONL by default)."""
+
+
+class _BucketRuntime:
+    """One bucket's device-side life: stacked states/keys/data, the two
+    compiled programs, and the per-slice harvest loop."""
+
+    def __init__(self, bucket: Bucket, out_root: str, slice_rounds: int,
+                 keep_repro: bool, events_jsonl: bool):
+        self.bucket = bucket
+        self.sim = bucket.runs[0].sim  # the representative: ONLY sim run
+        self.slice_rounds = int(slice_rounds)
+        self.keep_repro = keep_repro
+        self.sentinels_on = self.sim.sentinels is not None
+        runs = bucket.runs
+        self.keys = jnp.stack([r.key for r in runs])
+        self.data = jax.tree.map(lambda *ls: jnp.stack(ls),
+                                 *[r.sim.data for r in runs])
+        self.drop = jnp.asarray([r.request.config.drop_prob for r in runs],
+                                jnp.float32)
+        self.online = jnp.asarray(
+            [r.request.config.online_prob for r in runs], jnp.float32)
+        self.requested = [r.request.rounds for r in runs]
+        self.total_rounds = max(self.requested)
+        self.n_slices = math.ceil(self.total_rounds / self.slice_rounds)
+        self.rounds_done = 0
+        self.live = True
+        self.states = None
+        self.hc: Any = jnp.zeros((len(runs),), jnp.int32)  # dummy w/o sentinels
+        self._healthy: dict[int, Any] = {}
+        self._healthy_round = 0
+        self._accum: list[list[dict]] = [[] for _ in runs]
+        self._cache_events_before = dict(
+            compilation_cache_stats().get("events", {}))
+        self._cache_delta: dict = {}
+        # Metric names must be resolved from CONCRETE data before the
+        # step program traces with tracer-rebound sim.data (_maybe_eval
+        # consults them at trace time under eval_every > 1).
+        self.metric_names = self.sim._metric_keys()
+
+        self.out_dirs: dict[int, str] = {}
+        self._senders: list[_TenantSender] = []
+        self._receivers: list[Optional[JSONLinesReceiver]] = []
+        for i, r in enumerate(runs):
+            d = os.path.join(out_root, r.tenant)
+            os.makedirs(d, exist_ok=True)
+            self.out_dirs[i] = d
+            sender = _TenantSender()
+            rx = None
+            if events_jsonl:
+                path = os.path.join(d, "events.jsonl")
+                rx = JSONLinesReceiver(path)
+                sender.add_receiver(rx)
+                r.handle.artifacts["events"] = path
+            self._senders.append(sender)
+            self._receivers.append(rx)
+
+        self._init_fn = None
+        self._step_fn = None
+
+    # -- compiled programs -------------------------------------------------
+
+    def _make_init(self):
+        sim = self.sim
+        common_init = self.bucket.runs[0].request.config.common_init
+
+        def init_one(key, data):
+            saved = sim.data
+            sim.data = data
+            try:
+                return sim.init_nodes(key, common_init=common_init)
+            finally:
+                sim.data = saved
+
+        return jax.jit(jax.vmap(init_one))
+
+    def _make_step(self):
+        sim = self.sim
+        chunk = self.slice_rounds
+        sentinels_on = self.sentinels_on
+
+        def step_one(state, key, data, drop, online, hc):
+            # Rebind the per-tenant lane values onto the representative
+            # simulator for the duration of the trace (the _make_run
+            # pattern, extended to the fault rates — bernoulli takes a
+            # traced p, so tenants in one program may differ in them).
+            saved = (sim.data, sim.drop_prob, sim.online_prob)
+            sim.data = data
+            sim.drop_prob = drop
+            sim.online_prob = online
+            try:
+                last = state.round + chunk - 1
+
+                def body(carry, _):
+                    if sentinels_on:
+                        st, c = carry
+                        pre_params = st.model.params
+                        st, stats = sim._round(st, key, last)
+                        c, hstats = sim._health_round(c, pre_params, st,
+                                                      stats)
+                        stats.update(hstats)
+                        return (st, c), stats
+                    st, stats = sim._round(carry, key, last)
+                    return st, stats
+
+                init = (state, hc) if sentinels_on else state
+                final, stats = jax.lax.scan(body, init, None, length=chunk)
+                if sentinels_on:
+                    return final[0], final[1], stats
+                return final, hc, stats
+            finally:
+                sim.data, sim.drop_prob, sim.online_prob = saved
+
+        # Donate the state batch: the [T, D, N, ...] history rings are the
+        # dominant term and each slice's input is dead once the next
+        # slice's output exists (last-healthy copies are HOST numpy).
+        return jax.jit(jax.vmap(step_one, axis_name=BATCH_AXIS),
+                       donate_argnums=(0,))
+
+    def initialize(self) -> None:
+        self._init_fn = self._make_init()
+        self._step_fn = self._make_step()
+        self.states = self._init_fn(self.keys, self.data)
+        if self.sentinels_on:
+            zero = self.sim._health_zero_carry()
+            self.hc = jax.tree.map(
+                lambda l: jnp.broadcast_to(
+                    l[None], (self.bucket.size,) + l.shape).copy(), zero)
+        for r in self.bucket.runs:
+            r.handle.status = RunStatus.RUNNING
+        emit_event("service_bucket_start", {
+            "bucket": self.bucket.signature.digest,
+            "tenants": self.bucket.tenants,
+            "slice_rounds": self.slice_rounds,
+            "total_rounds": self.total_rounds,
+        })
+
+    # -- slice driving -----------------------------------------------------
+
+    def _live_lanes(self) -> list[int]:
+        return [i for i, r in enumerate(self.bucket.runs)
+                if r.handle.status is RunStatus.RUNNING]
+
+    def step(self) -> None:
+        """Advance every live tenant by one slice, harvest per-tenant
+        rows, and handle completions/evictions."""
+        lanes = self._live_lanes()
+        if not lanes:
+            self.live = False
+            return
+        if self.keep_repro:
+            # Host copies survive the donation of the batched source and
+            # become the bundle checkpoint if this slice trips a lane.
+            self._healthy = {i: slice_lane(self.states, i) for i in lanes}
+            self._healthy_round = self.rounds_done
+        chunk_start = self.rounds_done
+        saved_axis = self.sim._batch_axis_name
+        self.sim._batch_axis_name = BATCH_AXIS
+        try:
+            try:
+                self.states, self.hc, stats = self._step_fn(
+                    self.states, self.keys, self.data, self.drop,
+                    self.online, self.hc)
+                host = jax.tree.map(np.asarray, stats)
+            except Exception as e:  # the whole bucket program died
+                self._fail_all(e, chunk_start)
+                return
+        finally:
+            self.sim._batch_axis_name = saved_axis
+        if not self._cache_delta:
+            self._cache_delta = self._compute_cache_delta()
+        self.rounds_done += self.slice_rounds
+
+        for i in lanes:
+            run = self.bucket.runs[i]
+            h = run.handle
+            take = min(self.slice_rounds,
+                       self.requested[i] - h.rounds_completed)
+            rows = {k: v[i][:take] for k, v in host.items()}
+            trip_idx = None
+            if self.sentinels_on and "health_trip" in rows:
+                nz = np.nonzero(np.asarray(rows["health_trip"]) > 0)[0]
+                trip_idx = int(nz[0]) if nz.size else None
+            if trip_idx is not None:
+                rows = {k: v[:trip_idx + 1] for k, v in rows.items()}
+                self._harvest_rows(i, rows, chunk_start)
+                h.rounds_completed += trip_idx + 1
+                self._evict(i, chunk_start + trip_idx, rows)
+            else:
+                self._harvest_rows(i, rows, chunk_start)
+                h.rounds_completed += take
+                if h.rounds_completed >= self.requested[i]:
+                    self._finalize(i, RunStatus.DONE)
+        if not self._live_lanes():
+            self.live = False
+
+    def _compute_cache_delta(self) -> dict:
+        stats = compilation_cache_stats()
+        after = dict(stats.get("events", {}))
+        delta = {k: after.get(k, 0) - self._cache_events_before.get(k, 0)
+                 for k in set(after) | set(self._cache_events_before)}
+        return {"enabled": stats.get("enabled", False),
+                "events_delta": {k: v for k, v in sorted(delta.items())
+                                 if v}}
+
+    def _harvest_rows(self, i: int, rows: dict, chunk_start: int) -> None:
+        """Accumulate one tenant's slice rows and stream them out: replay
+        through the tenant's receivers (JSONL) and mirror a tagged
+        per-round event into the process sink (trailing context for
+        flight bundles; filter with ``events(where=...)``)."""
+        if rows["sent"].shape[0] == 0:
+            return
+        run = self.bucket.runs[i]
+        self._accum[i].append(rows)
+        sender = self._senders[i]
+        if sender._receivers_list():
+            sender.replay_events(chunk_start, rows, self.metric_names,
+                                 fire_end=False)
+        trips = rows.get("health_trip")
+        for j in range(rows["sent"].shape[0]):
+            emit_event("round", {
+                "tenant": run.tenant,
+                "round": chunk_start + j + 1,
+                "sent": int(rows["sent"][j]),
+                "failed": int(rows["failed"][j]),
+                "trip": bool(trips[j]) if trips is not None else False,
+            })
+
+    # -- completion / failure ----------------------------------------------
+
+    def _tenant_stats(self, i: int) -> Optional[dict]:
+        chunks = self._accum[i]
+        if not chunks:
+            return None
+        return {k: np.concatenate([c[k] for c in chunks], axis=0)
+                for k in chunks[0]}
+
+    def _build_tenant_report(self, i: int):
+        stats = self._tenant_stats(i)
+        if stats is None:
+            return None
+        cfg = self.bucket.runs[i].request.config
+        sim = self.sim
+        # The report's host-side derived fields (probe expected fan-in)
+        # read the simulator's fault-rate attributes — patch in the
+        # tenant's own for the duration of the build.
+        saved = (sim.drop_prob, sim.online_prob)
+        sim.drop_prob, sim.online_prob = cfg.drop_prob, cfg.online_prob
+        try:
+            return sim._build_report(stats)
+        finally:
+            sim.drop_prob, sim.online_prob = saved
+
+    def _tenant_manifest(self, i: int) -> RunManifest:
+        run = self.bucket.runs[i]
+        cfg = run.request.config
+        h = run.handle
+        return RunManifest.from_simulator(
+            self.sim,
+            extra={"service": {
+                "tenant": run.tenant,
+                "bucket": self.bucket.signature.digest,
+                "bucket_tenants": self.bucket.tenants,
+                "bucket_size": self.bucket.size,
+                "signature": self.bucket.signature.summary,
+                "slice_rounds": self.slice_rounds,
+                "rounds_requested": self.requested[i],
+                "rounds_completed": h.rounds_completed,
+                "status": h.status.value,
+                "bucket_compilation_cache": self._cache_delta,
+            }},
+            config_overrides={"drop_prob": cfg.drop_prob,
+                              "online_prob": cfg.online_prob,
+                              "seed": cfg.seed,
+                              "tenant": run.tenant})
+
+    def _finalize(self, i: int, status: RunStatus) -> None:
+        run = self.bucket.runs[i]
+        h = run.handle
+        h.status = status
+        h.report = self._build_tenant_report(i)
+        out = self.out_dirs[i]
+        if h.report is not None:
+            path = os.path.join(out, "report.json")
+            h.report.save(path)
+            h.artifacts["report"] = path
+        path = os.path.join(out, "manifest.json")
+        self._tenant_manifest(i).save(path)
+        h.artifacts["manifest"] = path
+        self._senders[i]._notify_end()
+        rx = self._receivers[i]
+        if rx is not None:
+            rx.close()
+            self._receivers[i] = None
+
+    def _evict(self, i: int, bad_round: int, rows: dict) -> None:
+        """Sentinel trip: write the tenant's repro bundle from its last
+        healthy lane state and drop it from the harvest (its lane keeps
+        computing garbage in future slices — vmapped lanes are
+        independent, so co-tenants are untouched and nothing reads the
+        dead lane again)."""
+        run = self.bucket.runs[i]
+        h = run.handle
+        detail: dict = {"tenant": run.tenant,
+                        "bucket": self.bucket.signature.digest}
+        nf = rows.get("health_nonfinite_params")
+        if nf is not None and len(nf):
+            detail["nonfinite_params_total"] = int(np.asarray(nf[-1]).sum())
+        div = rows.get("health_diverged_per_node")
+        if div is not None and len(div):
+            detail["diverged_nodes"] = int((np.asarray(div[-1]) > 0).sum())
+        if self.keep_repro and i in self._healthy:
+            rec = FlightRecorder(self.out_dirs[i])
+            h.bundle_path = rec.write_bundle(
+                self.sim, self._healthy[i], np.asarray(run.key), "sentinel",
+                self._healthy_round, first_bad_round=bad_round,
+                detail=detail, rounds_recorded=h.rounds_completed)
+        emit_event("tenant_evicted", {
+            "tenant": run.tenant,
+            "bucket": self.bucket.signature.digest,
+            "first_bad_round": bad_round,
+            "bundle_path": h.bundle_path,
+        })
+        self._finalize(i, RunStatus.EVICTED)
+
+    def _fail_all(self, error: Exception, chunk_start: int) -> None:
+        """The bucket's compiled program raised: every live tenant fails
+        together (one program, one fate), each with an exception bundle
+        from its last healthy state. Other BUCKETS are unaffected — the
+        service loop keeps driving them."""
+        self.live = False
+        for i in self._live_lanes():
+            run = self.bucket.runs[i]
+            h = run.handle
+            h.error = repr(error)[:500]
+            if self.keep_repro and i in self._healthy:
+                rec = FlightRecorder(self.out_dirs[i])
+                try:
+                    h.bundle_path = rec.write_bundle(
+                        self.sim, self._healthy[i], np.asarray(run.key),
+                        "exception", self._healthy_round,
+                        detail={"error": h.error, "tenant": run.tenant},
+                        rounds_recorded=h.rounds_completed)
+                except Exception:  # bundle is best-effort forensics
+                    pass
+            self._finalize(i, RunStatus.FAILED)
+        emit_event("bucket_failed", {
+            "bucket": self.bucket.signature.digest,
+            "error": repr(error)[:500],
+            "tenants": self.bucket.tenants,
+        })
+
+    def summary(self) -> dict:
+        out = {
+            "bucket": self.bucket.signature.digest,
+            "tenants": self.bucket.tenants,
+            "size": self.bucket.size,
+            "slice_rounds": self.slice_rounds,
+            "slices": math.ceil(self.rounds_done / self.slice_rounds),
+            "rounds_driven": self.rounds_done,
+            "compilation_cache": self._cache_delta
+                or self._compute_cache_delta(),
+            "signature": self.bucket.signature.summary,
+        }
+        # jit-cache proof of megabatching: one compiled step program per
+        # bucket regardless of tenant count (the acceptance counter).
+        for name, fn in (("init", self._init_fn), ("step", self._step_fn)):
+            try:
+                out[f"{name}_jit_cache_size"] = int(fn._cache_size())
+            except Exception:
+                out[f"{name}_jit_cache_size"] = None
+        return out
+
+
+class GossipService:
+    """Gossip-as-a-service front door: build, pack, schedule, report.
+
+    Usage::
+
+        svc = GossipService(out_dir="runs", slice_rounds=25)
+        q = RunQueue()
+        h1 = q.submit(RunRequest("alice", cfg_a))
+        h2 = q.submit(RunRequest("bob", cfg_b))
+        summary = svc.serve(q)          # drains everything pending
+        h1.report.final("accuracy")     # per-tenant results
+
+    ``slice_rounds`` is the cooperative quantum: buckets advance
+    round-robin one slice at a time, so a 10-tenant bucket cannot starve
+    a 1-tenant one. ``keep_repro=False`` skips the per-slice host copies
+    (faster slicing, but evictions lose their repro bundles).
+    """
+
+    def __init__(self, out_dir: str, slice_rounds: int = 25,
+                 keep_repro: bool = True, sentinels_default: bool = True,
+                 events_jsonl: bool = True):
+        self.out_dir = os.path.abspath(out_dir)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.slice_rounds = int(slice_rounds)
+        assert self.slice_rounds >= 1
+        self.keep_repro = bool(keep_repro)
+        self.sentinels_default = bool(sentinels_default)
+        self.events_jsonl = bool(events_jsonl)
+
+    def run(self, requests: list[RunRequest]) -> dict:
+        """Serve a fixed batch of requests (sugar over :meth:`serve`)."""
+        q = RunQueue()
+        for r in requests:
+            q.submit(r)
+        return self.serve(q)
+
+    def serve(self, queue: RunQueue) -> dict:
+        """Drain everything pending in ``queue``: build each request,
+        pack into shape buckets, drive all buckets to completion, write
+        per-tenant artifacts plus a ``service_summary.json``. Returns the
+        summary dict; per-tenant state lives on the queue's handles."""
+        t0 = time.time()
+        built: list[BuiltRun] = []
+        for h in queue.pending():
+            try:
+                built.append(build_request(
+                    h.request, handle=h,
+                    sentinels_default=self.sentinels_default))
+            except Exception as e:
+                h.status = RunStatus.FAILED
+                h.error = repr(e)[:500]
+        buckets = pack(built)
+        emit_event("service_packed", {
+            "tenants": [b.tenant for b in built],
+            "buckets": [{"bucket": b.signature.digest,
+                         "tenants": b.tenants} for b in buckets],
+        })
+        runtimes = [
+            _BucketRuntime(b, self.out_dir, self.slice_rounds,
+                           self.keep_repro, self.events_jsonl)
+            for b in buckets]
+        for rt in runtimes:
+            rt.initialize()
+        # Cooperative loop: one slice per live bucket per cycle.
+        while any(rt.live for rt in runtimes):
+            for rt in runtimes:
+                if rt.live:
+                    rt.step()
+        summary = {
+            "out_dir": self.out_dir,
+            "wall_seconds": round(time.time() - t0, 3),
+            "slice_rounds": self.slice_rounds,
+            "n_tenants": len(queue.handles()),
+            "n_buckets": len(buckets),
+            "megabatch_step_programs": len(buckets),
+            "compilation_cache": compilation_cache_stats(),
+            "buckets": [rt.summary() for rt in runtimes],
+            "tenants": [h.to_dict() for h in queue.handles()],
+        }
+        path = os.path.join(self.out_dir, "service_summary.json")
+        with open(path, "w") as fh:
+            json.dump(summary, fh, indent=2, default=str)
+            fh.write("\n")
+        summary["summary_path"] = path
+        emit_event("service_done", {
+            "n_tenants": summary["n_tenants"],
+            "n_buckets": summary["n_buckets"],
+            "wall_seconds": summary["wall_seconds"],
+        })
+        return summary

@@ -1,0 +1,166 @@
+"""Run specs, handles and the queue for gossip-as-a-service.
+
+One process, many experiments: a tenant describes a run as a JSON-able
+spec (an :class:`~gossipy_tpu.config.ExperimentConfig` plus a tenant name
+and an optional round-count override), submits it to a :class:`RunQueue`,
+and gets back a :class:`RunHandle` that tracks the run through the
+scheduler — queued, running, done, evicted (sentinel trip + flight-
+recorder bundle) or failed — and, on completion, carries the tenant's own
+:class:`~gossipy_tpu.simulation.report.SimulationReport` and artifact
+paths. The packer (:mod:`gossipy_tpu.service.packer`) fuses same-shape
+requests into one vmapped megabatch program; the scheduler
+(:mod:`gossipy_tpu.service.scheduler`) drives the buckets cooperatively.
+
+Spec format (``RunRequest.from_spec`` / ``scripts/serve.py``)::
+
+    {"tenant": "alice-lr01",
+     "config": { ... ExperimentConfig fields ... },
+     "n_rounds": 200}          # optional, overrides config.n_rounds
+
+The spec's ``config`` is strict (unknown fields raise, same as
+``ExperimentConfig.from_dict``), so a typo'd knob fails at submission,
+not after a bucket compiled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+from ..config import ExperimentConfig
+
+
+class RunStatus(enum.Enum):
+    """Lifecycle of a tenant run inside the service."""
+
+    QUEUED = "queued"      # submitted, not yet packed into a bucket
+    RUNNING = "running"    # its bucket is being driven
+    DONE = "done"          # requested rounds completed, report final
+    EVICTED = "evicted"    # sentinel tripped: bundle written, lane dropped
+    FAILED = "failed"      # its bucket's program raised (all co-tenants too)
+
+
+# Simulator kinds the megabatch scheduler cannot drive: the sequential
+# engine is eager host-side Python (nothing to vmap), and PENS switches
+# its traced program mid-run via a host-side phase salt, which a single
+# bucket-wide scan cannot express. Submit these as solo runs instead.
+UNSERVABLE_SIMULATORS = ("sequential", "pens")
+
+
+@dataclasses.dataclass
+class RunRequest:
+    """One tenant's run: a declarative config plus service metadata.
+
+    ``data`` optionally overrides the config's dataset with a pre-loaded
+    ``(X, y)`` tuple (same contract as
+    :func:`gossipy_tpu.config.build_experiment`) — tenants in one bucket
+    may carry entirely different data VALUES; shapes are part of the
+    packer's signature.
+    """
+
+    tenant: str
+    config: ExperimentConfig
+    n_rounds: Optional[int] = None   # None = config.n_rounds
+    data: Optional[tuple] = None     # (X, y) override for build_experiment
+
+    def __post_init__(self):
+        if not self.tenant or "/" in self.tenant:
+            raise ValueError(
+                f"tenant name must be a non-empty path-safe string, got "
+                f"{self.tenant!r} (it names the artifact directory)")
+        if self.config.simulator in UNSERVABLE_SIMULATORS:
+            raise ValueError(
+                f"simulator {self.config.simulator!r} cannot be served by "
+                f"the megabatch scheduler ({', '.join(UNSERVABLE_SIMULATORS)}"
+                f" are host-phase/eager engines); run it solo via "
+                f"run_experiment()")
+        if self.config.repetitions != 1:
+            raise ValueError(
+                "service runs are single-seed per tenant (submit one "
+                "request per seed — the packer fuses them into one "
+                "program anyway); got repetitions="
+                f"{self.config.repetitions}")
+
+    @property
+    def rounds(self) -> int:
+        return int(self.n_rounds if self.n_rounds is not None
+                   else self.config.n_rounds)
+
+    @staticmethod
+    def from_spec(spec: dict) -> "RunRequest":
+        """Build a request from the JSON spec format (see module doc)."""
+        unknown = set(spec) - {"tenant", "config", "n_rounds"}
+        if unknown:
+            raise ValueError(f"unknown spec fields: {sorted(unknown)}; "
+                             f"valid: tenant, config, n_rounds")
+        if "tenant" not in spec or "config" not in spec:
+            raise ValueError("a run spec needs 'tenant' and 'config'")
+        return RunRequest(
+            tenant=str(spec["tenant"]),
+            config=ExperimentConfig.from_dict(dict(spec["config"])),
+            n_rounds=spec.get("n_rounds"),
+        )
+
+
+@dataclasses.dataclass
+class RunHandle:
+    """Mutable per-tenant tracking record the scheduler updates in place.
+
+    ``report`` is the tenant's own :class:`SimulationReport` (final for
+    DONE, truncated at the tripped round for EVICTED, absent for FAILED);
+    ``artifacts`` maps artifact names (``report``, ``manifest``,
+    ``events``) to written paths; ``bundle_path`` points at the
+    flight-recorder repro bundle of an evicted tenant.
+    """
+
+    request: RunRequest
+    status: RunStatus = RunStatus.QUEUED
+    rounds_completed: int = 0
+    report: Optional[Any] = None
+    bundle_path: Optional[str] = None
+    error: Optional[str] = None
+    bucket: Optional[str] = None          # signature digest once packed
+    artifacts: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def tenant(self) -> str:
+        return self.request.tenant
+
+    def to_dict(self) -> dict:
+        """JSON-able summary row (the serve CLI's per-tenant output)."""
+        return {
+            "tenant": self.tenant,
+            "status": self.status.value,
+            "rounds_requested": self.request.rounds,
+            "rounds_completed": self.rounds_completed,
+            "bucket": self.bucket,
+            "bundle_path": self.bundle_path,
+            "error": self.error,
+            "artifacts": dict(self.artifacts),
+        }
+
+
+class RunQueue:
+    """FIFO submission queue: tenants submit :class:`RunRequest`\\ s, the
+    scheduler drains whatever is pending when a service cycle starts.
+    Host-side and single-process — the multiplexing happens on the
+    device, not here."""
+
+    def __init__(self):
+        self._handles: list[RunHandle] = []
+
+    def submit(self, request: RunRequest) -> RunHandle:
+        if any(h.tenant == request.tenant for h in self._handles
+               if h.status in (RunStatus.QUEUED, RunStatus.RUNNING)):
+            raise ValueError(f"tenant {request.tenant!r} already has a "
+                             f"queued or running request")
+        handle = RunHandle(request=request)
+        self._handles.append(handle)
+        return handle
+
+    def pending(self) -> list[RunHandle]:
+        return [h for h in self._handles if h.status is RunStatus.QUEUED]
+
+    def handles(self) -> list[RunHandle]:
+        return list(self._handles)

@@ -92,6 +92,12 @@ PROTO_TO_MSG = {
     AntiEntropyProtocol.PUSH_PULL: MessageType.PUSH_PULL,
 }
 
+# The vmapped-batch axis name bound by every seed/tenant-batched round
+# program (run_repetitions, the service megabatch): the compact/wide
+# delivery dispatch reduces its predicate over this axis so the lax.cond
+# stays batch-uniform (see GossipSimulator._slot_live_count).
+BATCH_AXIS = "gossipy_batch"
+
 
 _HOST_CALLBACKS_SUPPORTED: Optional[bool] = None
 
@@ -336,9 +342,12 @@ class GossipSimulator(SimulationEventSender):
         run unfused full-width; ``_decode_extra`` overrides are fine — the
         decoded arg is gathered — provided they are elementwise, which all
         in-tree ones are). An int pins the capacity explicitly.
-        :meth:`run_repetitions` always runs its seed-vmapped program with
-        compaction off — a vmapped ``lax.cond`` predicate executes both
-        branches, which would ADD the compact pass to every wide one.
+        Under a seed/tenant vmap (:meth:`run_repetitions`, the service
+        megabatch) the compact/wide dispatch predicate is reduced across
+        the batch axis (``lax.pmax``) before the ``lax.cond`` so it stays
+        batch-uniform — a vmapped cond predicate would otherwise execute
+        both branches, ADDING the compact pass to every wide one. The
+        whole batch takes the compact pass only when every lane fits.
     history_dtype : str
         Wire/storage format of the params-history ring — what a message's
         payload snapshot is stored (and therefore gathered) as:
@@ -396,6 +405,17 @@ class GossipSimulator(SimulationEventSender):
     # setting ``_compact_safe = True`` before compact delivery auto-enables
     # for them. In-tree variants set it; the base pipeline needs no flag.
     _compact_safe: bool = False
+
+    # Name of the vmapped batch axis when the round program is being traced
+    # under a seed/tenant vmap (run_repetitions, the service megabatch), or
+    # None for a plain single-simulation trace. A ``lax.cond`` whose
+    # predicate is batched executes BOTH branches, so the compact/wide
+    # delivery dispatch reduces its slot-overflow predicate across this
+    # axis (``lax.pmax``) to stay batch-uniform: the whole batch takes the
+    # compact pass only when EVERY lane's live count fits the capacity
+    # (conservative and semantics-preserving — the wide pass is always
+    # correct). Set only for the duration of a batched trace.
+    _batch_axis_name: Optional[str] = None
 
     _HISTORY_DTYPES = ("float32", "bfloat16", "int8")
 
@@ -1115,6 +1135,20 @@ class GossipSimulator(SimulationEventSender):
         ages = state.history_ages[b, s]
         return PeerModel(params, ages)
 
+    def _slot_live_count(self, valid) -> jax.Array:
+        """The live-receiver count the compact/wide dispatch compares to
+        the static capacity. Under a seed/tenant vmap
+        (``_batch_axis_name`` set) the count is maximized across the batch
+        axis so the resulting ``lax.cond`` predicate is batch-uniform —
+        the cond stays a real cond (one branch executes) instead of being
+        lowered to a both-branches select. Conservative per lane: a lane
+        that fits takes the wide pass when a co-lane overflows, which is
+        always correct (compaction never changes results)."""
+        live = valid.sum()
+        if self._batch_axis_name is not None:
+            live = jax.lax.pmax(live, self._batch_axis_name)
+        return live
+
     def _receive_slot_apply(self, state: SimState, send_round, sender, extra,
                             valid, call_key) -> SimState:
         """Process one mailbox slot: fetch the senders' snapshots and apply
@@ -1129,7 +1163,7 @@ class GossipSimulator(SimulationEventSender):
             # an overflowing slot (typically slot 0) takes the full-width
             # pass. Both branches live in the compiled program once.
             return jax.lax.cond(
-                valid.sum() <= self._compact_cap,
+                self._slot_live_count(valid) <= self._compact_cap,
                 lambda st: self._apply_receive_compact(
                     st, send_round, sender, extra, valid, call_key),
                 lambda st: self._apply_receive_wide(
@@ -1252,7 +1286,8 @@ class GossipSimulator(SimulationEventSender):
         occupied_slot = apply_mask.any()
         if self._compact_cap is None:
             return jnp.int32(0), occupied_slot.astype(jnp.int32)
-        took_compact = occupied_slot & (apply_mask.sum() <= self._compact_cap)
+        took_compact = occupied_slot & \
+            (self._slot_live_count(apply_mask) <= self._compact_cap)
         return (took_compact.astype(jnp.int32),
                 (occupied_slot & ~took_compact).astype(jnp.int32))
 
@@ -2076,19 +2111,21 @@ class GossipSimulator(SimulationEventSender):
                 final, stats = jax.lax.scan(body, init, None,
                                             length=n_rounds)
                 return (final[0] if sentinels_on else final), stats
-            self._jit_cache[cache_k] = jax.jit(jax.vmap(one))
+            self._jit_cache[cache_k] = jax.jit(
+                jax.vmap(one, axis_name=BATCH_AXIS))
 
-        # Under the seed vmap the compact/wide dispatch predicate is
-        # batched, and a lax.cond with a batched predicate executes BOTH
-        # branches — compaction would add the [cap] pass on top of every
-        # full-width pass instead of replacing it. Trace (first call) and
-        # run the repetition program with compaction off; start() keeps it.
-        saved_cap = self._compact_cap
-        self._compact_cap = None
+        # The seed vmap binds BATCH_AXIS so the compact/wide dispatch can
+        # reduce its slot-overflow predicate across the batch and keep the
+        # lax.cond batch-uniform (a batched predicate would execute BOTH
+        # branches, adding the compact pass on top of every wide one).
+        # The attribute only matters while the first call traces; restored
+        # unconditionally so single-simulation start() traces stay plain.
+        saved_axis = self._batch_axis_name
+        self._batch_axis_name = BATCH_AXIS
         try:
             states, stats = self._jit_cache[cache_k](keys)
         finally:
-            self._compact_cap = saved_cap
+            self._batch_axis_name = saved_axis
         host = jax.tree.map(np.asarray, stats)  # one device->host transfer
         n_reps = host["sent"].shape[0]
         reports = [self._build_report(jax.tree.map(lambda a, i=i: a[i], host))

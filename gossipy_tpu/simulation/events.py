@@ -148,11 +148,15 @@ class SimulationEventSender:
 
     def replay_events(self, first_round: int, stats: dict,
                       metric_names: list[str],
-                      include_live: bool = False) -> None:
+                      include_live: bool = False,
+                      fire_end: bool = True) -> None:
         """Replay recorded per-round stats (host arrays) through non-live
         receivers, then fire ``update_end``. ``include_live=True`` also
         replays to live receivers — used when the backend cannot run host
-        callbacks and the in-run delivery was disabled."""
+        callbacks and the in-run delivery was disabled. ``fire_end=False``
+        suppresses the final ``update_end`` — chunked drivers (the service
+        scheduler streaming one slice of rounds at a time) replay several
+        segments through the same receivers and fire the end themselves."""
         if not self._receivers_list():
             return
         sent = np.asarray(stats["sent"])
@@ -188,7 +192,8 @@ class SimulationEventSender:
                                row(local, i), row(glob, i),
                                include_live=include_live, causes=causes,
                                probes=probes, health=health)
-        self._notify_end()
+        if fire_end:
+            self._notify_end()
 
 
 class ProgressReceiver(SimulationEventReceiver):

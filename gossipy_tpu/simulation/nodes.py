@@ -17,7 +17,8 @@ import numpy as np
 
 from ..core import CreateModelMode
 from ..handlers.base import ModelState, PeerModel
-from .engine import GossipSimulator, SimState, select_nodes, _K_PEER
+from .engine import BATCH_AXIS, GossipSimulator, SimState, select_nodes, \
+    _K_PEER
 from .report import SimulationReport
 
 # Variant PRNG purpose tags (>= 9000 per the engine's stream-tag contract).
@@ -543,9 +544,19 @@ class PENSGossipSimulator(GossipSimulator):
             # Donate the stacked segment-1 states: the [S, D, N, ...]
             # history rings are the dominant term and the inputs are dead
             # after this call (start()'s donation policy, applied here).
-            self._jit_cache[cache_k] = jax.jit(jax.vmap(cont),
-                                               donate_argnums=(0,))
-        states, stats2 = self._jit_cache[cache_k](states, keys)
+            # The vmap binds BATCH_AXIS like every seed-batched round
+            # program (base run_repetitions, the service megabatch): PENS
+            # itself never compacts (_apply_receive override), but the
+            # contract is uniform so a compact-capable subclass of this
+            # variant would stay batch-uniform for free.
+            self._jit_cache[cache_k] = jax.jit(
+                jax.vmap(cont, axis_name=BATCH_AXIS), donate_argnums=(0,))
+        saved_axis = self._batch_axis_name
+        self._batch_axis_name = BATCH_AXIS
+        try:
+            states, stats2 = self._jit_cache[cache_k](states, keys)
+        finally:
+            self._batch_axis_name = saved_axis
         host2 = jax.tree.map(np.asarray, stats2)
         reports = []
         for i, rep1 in enumerate(reports1):

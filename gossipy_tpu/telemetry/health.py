@@ -421,6 +421,26 @@ class FlightRecorder:
         self.bundle_path = path
         return path
 
+    def write_bundle(self, sim, state, key, kind: str,
+                     chunk_start_round: int,
+                     first_bad_round: Optional[int] = None,
+                     detail: Optional[dict] = None,
+                     rounds_recorded: Optional[int] = None) -> str:
+        """Public bundle capture for EXTERNAL drivers — chunked loops the
+        recorder does not own, like the multi-tenant service scheduler
+        evicting a tripped tenant. ``state`` must be the last HEALTHY
+        state at round ``chunk_start_round`` (host numpy copies are fine —
+        :func:`gossipy_tpu.checkpoint.slice_lane` extracts a tenant lane
+        from a batched megabatch state). ``rounds_recorded`` tells the
+        trailing-window truncation check how many rounds the driver
+        mirrored into the sink (0/None disables the warning). Returns the
+        bundle path; :meth:`run` callers never need this."""
+        if rounds_recorded is not None:
+            self._rounds_recorded = int(rounds_recorded)
+        return self._write_bundle(sim, state, key, kind, chunk_start_round,
+                                  first_bad_round=first_bad_round,
+                                  detail=detail)
+
     # -- driving -----------------------------------------------------------
 
     def run(self, sim, state, n_rounds: int, key,

@@ -151,13 +151,21 @@ class RunManifest:
     @classmethod
     def from_simulator(cls, sim: Any,
                        compile_seconds: Optional[float] = None,
-                       extra: Optional[dict] = None) -> "RunManifest":
+                       extra: Optional[dict] = None,
+                       config_overrides: Optional[dict] = None
+                       ) -> "RunManifest":
         """Collect the manifest for ``sim``.
 
         ``compile_seconds`` defaults to the simulator's recorded
         ``last_compile_seconds`` (the wall time of the most recent cold
         ``start()`` dispatch — tracing + XLA compilation; execution is
         dispatched asynchronously and not included).
+
+        ``config_overrides`` patches entries of the config snapshot AFTER
+        collection — the multi-tenant scheduler records each tenant's OWN
+        fault rates/seed through the shared bucket simulator (whose
+        attributes hold the representative tenant's values), so a
+        per-tenant manifest stays attributable to its tenant.
         """
         budget = None
         if hasattr(sim, "memory_budget"):
@@ -180,8 +188,11 @@ class RunManifest:
                           "maxlen": sink.maxlen}
         except Exception:
             sink_stats = None
+        config = _config_snapshot(sim)
+        if config_overrides:
+            config.update(config_overrides)
         return cls(
-            config=_config_snapshot(sim),
+            config=config,
             backend=_backend_info(),
             versions=_versions(),
             git_rev=git_revision(),

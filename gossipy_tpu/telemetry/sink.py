@@ -24,7 +24,7 @@ import json
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 
 @dataclass
@@ -68,9 +68,20 @@ class TelemetrySink:
             self._fh.write(json.dumps(ev.to_dict()) + "\n")
         return ev
 
-    def events(self, kind: Optional[str] = None) -> list:
+    def events(self, kind: Optional[str] = None,
+               where: Optional[Callable[[TelemetryEvent], bool]] = None
+               ) -> list:
+        """Events currently in the ring, optionally filtered by ``kind``
+        and/or an arbitrary ``where`` predicate — multi-tenant drivers tag
+        their events (``data["tenant"]``) and route per-tenant views out
+        of the one process ring with
+        ``events(where=lambda e: e.data.get("tenant") == tid)``."""
         evs = list(self._events)
-        return evs if kind is None else [e for e in evs if e.kind == kind]
+        if kind is not None:
+            evs = [e for e in evs if e.kind == kind]
+        if where is not None:
+            evs = [e for e in evs if where(e)]
+        return evs
 
     def clear(self) -> None:
         self._events.clear()

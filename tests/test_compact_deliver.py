@@ -107,17 +107,48 @@ class TestCompactEquivalence:
 
 
 class TestCompactRepetitions:
-    def test_run_repetitions_disables_compaction_and_matches(self, key):
-        # A vmapped cond predicate executes both branches, so the seed-
-        # batched program always traces with compaction off; a compact-
-        # configured sim must produce the same repetition curves as a
-        # plain one and leave its cap restored for start().
+    """The seed-vmapped megabatch program COMPACTS: the slot-overflow
+    predicate is reduced across the batch axis (``lax.pmax`` under the
+    vmap's axis name) before the ``lax.cond``, so the dispatch stays
+    batch-uniform — one branch executes — instead of a batched predicate
+    silently adding the compact pass on top of every wide one (which is
+    why earlier rounds forced compaction off here)."""
+
+    def test_seed_vmapped_program_compacts_and_matches(self, key):
+        # cap == population: every slot fits on every lane, so the whole
+        # batch takes the compact branch — the counters must prove it —
+        # and the curves must equal the never-compacting sim's.
         keys = jax.random.split(key, 3)
-        sim_on = make_sim(4)
+        sim_on = make_sim(16)
         sim_off = make_sim(False)
         _, reps_on = sim_on.run_repetitions(5, keys)
         _, reps_off = sim_off.run_repetitions(5, keys)
-        assert sim_on._compact_cap == 4  # restored after the vmapped run
+        assert sim_on._compact_cap == 16
+        assert sim_on._batch_axis_name is None  # restored after the run
+        compact = sum(int(np.asarray(r.compact_slots_per_round).sum())
+                      for r in reps_on)
+        wide = sum(int(np.asarray(r.wide_slots_per_round).sum())
+                   for r in reps_on)
+        assert compact > 0 and wide == 0, (compact, wide)
+        for a, b in zip(reps_on, reps_off):
+            np.testing.assert_allclose(a.curves(local=False)["accuracy"],
+                                       b.curves(local=False)["accuracy"],
+                                       atol=1e-6)
+
+    def test_mixed_overflow_stays_batch_uniform_and_matches(self, key):
+        # cap=2 on 16 nodes: slot 0 overflows on some lane nearly every
+        # round (every lane then takes the wide pass — the pmax makes the
+        # overflow decision collective), higher slots fit on all lanes
+        # (compact). Both branches execute across the run; per-seed
+        # trajectories must equal the never-compacting program's.
+        keys = jax.random.split(key, 3)
+        _, reps_on = make_sim(2).run_repetitions(5, keys)
+        _, reps_off = make_sim(False).run_repetitions(5, keys)
+        compact = sum(int(np.asarray(r.compact_slots_per_round).sum())
+                      for r in reps_on)
+        wide = sum(int(np.asarray(r.wide_slots_per_round).sum())
+                   for r in reps_on)
+        assert compact > 0 and wide > 0, (compact, wide)
         for a, b in zip(reps_on, reps_off):
             np.testing.assert_allclose(a.curves(local=False)["accuracy"],
                                        b.curves(local=False)["accuracy"],

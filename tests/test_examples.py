@@ -60,6 +60,20 @@ def test_example_smoke(script, args):
     assert all(np.isfinite(v) for v in summary["final"].values()), summary
 
 
+def test_service_example_smoke(tmp_path):
+    """The multi-tenant scheduler demo (its own summary shape: the
+    script itself asserts bucket counts, solo parity and the eviction)."""
+    summary = run_example(
+        "main_service.py",
+        ["--nodes", "16", "--rounds", "6", "--slice", "3",
+         "--out", str(tmp_path)])
+    assert summary["n_buckets"] == 2
+    assert summary["megabatch_step_programs"] == 2
+    assert summary["tenants"]["alice"]["status"] == "done"
+    assert summary["tenants"]["mallory"]["status"] == "evicted"
+    assert summary["tenants"]["mallory"]["bundle"]
+
+
 def test_config_runner_smoke(tmp_path):
     """main_from_config runs an experiment from a JSON file end to end."""
     from gossipy_tpu.config import ExperimentConfig

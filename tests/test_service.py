@@ -1,0 +1,240 @@
+"""Gossip-as-a-service: packer bucketing + scheduler end-to-end.
+
+Covers the multi-tenant subsystem's two contracts:
+
+- **Packing** (service/packer.py): runs fuse into one bucket exactly when
+  their compiled-program shape signatures match — seeds, data values and
+  fault rates may differ; population, model, wire format and topology
+  content may not.
+- **Scheduling** (service/scheduler.py): a bucket executes as ONE
+  tenant-vmapped megabatch program whose per-tenant results equal the
+  solo ``run_experiment`` trajectories; a tenant whose lane trips the
+  numerics sentinels is evicted with a flight-recorder repro bundle
+  (deterministically replayable) while its co-tenant finishes clean.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gossipy_tpu.config import ExperimentConfig, run_experiment
+from gossipy_tpu.service import (
+    GossipService,
+    RunQueue,
+    RunRequest,
+    RunStatus,
+    build_request,
+    pack,
+)
+
+D_FEATURES = 8
+
+
+def tenant_data(seed: int, n: int = 240, d: int = D_FEATURES,
+                poison: bool = False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+    if poison:
+        # Non-finite feature rows: the first local update propagates the
+        # inf into that tenant's params, tripping the nonfinite sentinel.
+        X[: n // 8] = np.inf
+    return X, y
+
+
+def base_cfg(**over) -> ExperimentConfig:
+    base = dict(n_nodes=16, model="logreg", handler="sgd",
+                topology="random_regular", topology_params={"degree": 4},
+                delta=20, n_rounds=6, batch_size=8)
+    base.update(over)
+    return ExperimentConfig(**base)
+
+
+def build(tenant: str, cfg: ExperimentConfig, data_seed: int = 1,
+          poison: bool = False):
+    return build_request(RunRequest(tenant, cfg,
+                                    data=tenant_data(data_seed,
+                                                     poison=poison)))
+
+
+class TestPacker:
+    def test_variable_fields_fuse(self):
+        # Different seed, data values and fault rates: one bucket.
+        built = [
+            build("a", base_cfg(seed=1), data_seed=1),
+            build("b", base_cfg(seed=2, drop_prob=0.2), data_seed=2),
+            build("c", base_cfg(seed=3, online_prob=0.8, n_rounds=9),
+                  data_seed=3),
+        ]
+        buckets = pack(built)
+        assert len(buckets) == 1
+        assert buckets[0].tenants == ["a", "b", "c"]
+        assert len({r.signature.digest for r in built}) == 1
+
+    def test_shape_fields_split(self):
+        # Population, model and wire format each change the compiled
+        # program: three more buckets.
+        built = [
+            build("a", base_cfg(seed=1)),
+            build("n", base_cfg(seed=1, n_nodes=24), data_seed=2),
+            build("m", base_cfg(seed=1, model="mlp"), data_seed=3),
+            build("w", base_cfg(
+                seed=1, simulator_params={"history_dtype": "bfloat16"}),
+                data_seed=4),
+        ]
+        assert len(pack(built)) == 4
+
+    def test_topology_content_splits(self):
+        # Same builder kind, different degree: the closed-over adjacency
+        # differs, so the runs must not share a program.
+        built = [
+            build("a", base_cfg(seed=1)),
+            build("d", base_cfg(seed=1,
+                                topology_params={"degree": 6}),
+                  data_seed=2),
+        ]
+        assert len(pack(built)) == 2
+
+    def test_data_shape_splits(self):
+        # Same config shape fields, different stacked-data geometry
+        # (bigger per-tenant shard): separate buckets.
+        a = build("a", base_cfg(seed=1))
+        b = build_request(RunRequest("big", base_cfg(seed=1),
+                                     data=tenant_data(2, n=480)))
+        assert len(pack([a, b])) == 2
+
+    def test_sentinel_injection_in_signature(self):
+        # The service injects sentinels=True; a tenant explicitly opting
+        # OUT traces a different program and buckets apart.
+        a = build("a", base_cfg(seed=1))
+        assert a.sim.sentinels is not None
+        off = build_request(RunRequest(
+            "off", base_cfg(seed=1,
+                            simulator_params={"sentinels": False}),
+            data=tenant_data(2)))
+        assert off.sim.sentinels is None
+        assert len(pack([a, off])) == 2
+
+    def test_unservable_simulators_rejected(self):
+        for kind in ("sequential", "pens"):
+            with pytest.raises(ValueError, match="cannot be served"):
+                RunRequest("t", base_cfg(simulator=kind))
+
+    def test_queue_rejects_duplicate_live_tenant(self):
+        q = RunQueue()
+        q.submit(RunRequest("t", base_cfg()))
+        with pytest.raises(ValueError, match="already has"):
+            q.submit(RunRequest("t", base_cfg(seed=2)))
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One scheduler run shared by the e2e assertions: two same-bucket
+    tenants — ``good`` and ``bad`` (poisoned data) — plus the solo
+    reference trajectory for ``good``."""
+    out = tmp_path_factory.mktemp("service")
+    cfg_good = base_cfg(seed=1)
+    cfg_bad = base_cfg(seed=2)
+    q = RunQueue()
+    h_good = q.submit(RunRequest("good", cfg_good, data=tenant_data(1)))
+    h_bad = q.submit(RunRequest("bad", cfg_bad,
+                                data=tenant_data(2, poison=True)))
+    svc = GossipService(str(out), slice_rounds=4)
+    summary = svc.serve(q)
+
+    solo_cfg = dataclasses.replace(
+        cfg_good, simulator_params={"sentinels": True})
+    _, solo_report = run_experiment(solo_cfg, data=tenant_data(1))
+    return {"out": str(out), "summary": summary, "good": h_good,
+            "bad": h_bad, "cfg_bad": cfg_bad, "solo": solo_report}
+
+
+class TestSchedulerE2E:
+    def test_one_bucket_one_step_program(self, served):
+        s = served["summary"]
+        assert s["n_buckets"] == 1
+        assert s["megabatch_step_programs"] == 1
+        b = s["buckets"][0]
+        assert sorted(b["tenants"]) == ["bad", "good"]
+        # jit-cache proof: the shared step fn compiled exactly once.
+        assert b["step_jit_cache_size"] in (1, None)
+        assert "compilation_cache" in b
+
+    def test_co_tenant_completes_clean_and_matches_solo(self, served):
+        h = served["good"]
+        assert h.status is RunStatus.DONE
+        assert h.rounds_completed == 6
+        rep = h.report
+        assert int(np.sum(rep.health_trip)) == 0
+        np.testing.assert_allclose(
+            served["solo"].curves(local=False)["accuracy"],
+            rep.curves(local=False)["accuracy"], atol=2e-5)
+        np.testing.assert_array_equal(served["solo"].sent_per_round,
+                                      rep.sent_per_round)
+
+    def test_poisoned_tenant_evicted_with_bundle(self, served):
+        h = served["bad"]
+        assert h.status is RunStatus.EVICTED
+        assert h.bundle_path is not None and os.path.isdir(h.bundle_path)
+        with open(os.path.join(h.bundle_path, "verdict.json")) as fh:
+            verdict = json.load(fh)
+        assert verdict["kind"] == "sentinel"
+        assert verdict["first_bad_round"] == 0
+        assert verdict["detail"]["tenant"] == "bad"
+        assert verdict["detail"]["nonfinite_params_total"] > 0
+        # The truncated report stops at the tripped round.
+        assert h.rounds_completed == 1
+        assert int(np.asarray(h.report.health_trip)[-1]) > 0
+
+    def test_per_tenant_artifacts(self, served):
+        from gossipy_tpu.simulation.events import JSONLinesReceiver
+        for name in ("good", "bad"):
+            h = served[name]
+            assert os.path.isfile(h.artifacts["report"])
+            assert os.path.isfile(h.artifacts["manifest"])
+            with open(h.artifacts["events"]) as fh:
+                rows = [JSONLinesReceiver.parse_line(l) for l in fh]
+            assert len(rows) == h.rounds_completed
+            assert rows[0]["round"] == 1
+            assert all(r["health"] is not None for r in rows)
+        # The evicted tenant's last row carries the trip.
+        assert rows[-1]["health"]["trip"] is True
+
+    def test_per_tenant_manifest_attribution(self, served):
+        with open(served["bad"].artifacts["manifest"]) as fh:
+            m = json.load(fh)
+        assert m["config"]["tenant"] == "bad"
+        assert m["config"]["seed"] == 2
+        svc = m["extra"]["service"]
+        assert svc["bucket"] == served["summary"]["buckets"][0]["bucket"]
+        assert sorted(svc["bucket_tenants"]) == ["bad", "good"]
+        assert svc["status"] == "evicted"
+        assert "bucket_compilation_cache" in svc
+        assert "data_shapes" in svc["signature"]
+
+    def test_bundle_replays_deterministically(self, served):
+        # The bundle's lane checkpoint + the tenant's own config/data
+        # rebuild the failure: replay names the recorded first bad round.
+        from gossipy_tpu.config import build_experiment
+        from gossipy_tpu.telemetry.health import replay_bundle
+        cfg = dataclasses.replace(
+            served["cfg_bad"], simulator_params={"sentinels": True})
+        sim, _ = build_experiment(cfg, tenant_data(2, poison=True))
+        verdict = replay_bundle(served["bad"].bundle_path, sim,
+                                localize=False)
+        assert verdict["first_bad_round"] == 0
+        assert verdict["matches_recorded"] is True
+        assert verdict["trip"] == "nonfinite"
+
+    def test_tenant_tagged_sink_routing(self, served):
+        from gossipy_tpu.telemetry import get_sink
+        sink = get_sink()
+        mine = sink.events(kind="round",
+                           where=lambda e: e.data.get("tenant") == "good")
+        if mine:  # ring may have been rotated by other tests
+            assert all(e.data["tenant"] == "good" for e in mine)
+        evs = sink.events(kind="tenant_evicted")
+        assert any(e.data["tenant"] == "bad" for e in evs)
