@@ -65,6 +65,12 @@ PROBE_INFO: dict = {}
 # overhead of the opt-in numerics sentinels (telemetry.health; ISSUE-4
 # acceptance target < 5% on this config). Merged into raw.
 SENTINEL_INFO: dict = {}
+# Chaos-on vs chaos-off throughput stamp (north-star mode): the overhead
+# of the opt-in scheduled fault-injection layer (simulation.faults;
+# ISSUE-7 acceptance target < 5% like sentinels) under a representative
+# scenario — a half/half partition plus a drop spike inside the measured
+# window. Merged into raw.
+CHAOS_INFO: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -150,8 +156,27 @@ def make_data():
     return X, y
 
 
+def bench_chaos_config(n_rounds: int):
+    """The representative chaos scenario for the A/B stamp: the
+    population partitioned in half for the middle third of the measured
+    window, plus a short drop spike — edge masks, component probes-free
+    schedule gathers and a traced drop rate all exercised."""
+    from gossipy_tpu.simulation.faults import ChaosConfig, FaultSpike, \
+        PartitionEpisode
+    a = max(n_rounds // 3, 1)
+    b = max(2 * n_rounds // 3, a + 1)
+    half = N_NODES // 2
+    return ChaosConfig(
+        partitions=(PartitionEpisode(
+            components=(tuple(range(half)), tuple(range(half, N_NODES))),
+            start=a, stop=b),),
+        spikes=(FaultSpike(start=b, stop=b + max(n_rounds // 10, 1),
+                           drop_prob=0.2),),
+        horizon=n_rounds)
+
+
 def build_sim(X, y, fused: bool = False, probes: bool = False,
-              sentinels: bool = False):
+              sentinels: bool = False, chaos=None):
     """The bench configuration (shared by the throughput and to-accuracy
     modes): 100 nodes, LogReg SGD, MERGE_UPDATE, PUSH over a 20-regular
     graph, per-round global eval."""
@@ -178,16 +203,18 @@ def build_sim(X, y, fused: bool = False, probes: bool = False,
                            fused_merge=fused,
                            history_dtype=HISTORY_DTYPE,
                            probes=probes,
-                           sentinels=sentinels)
+                           sentinels=sentinels,
+                           chaos=chaos)
 
 
 def bench_ours(X, y) -> float:
     import jax
 
-    def run(fused: bool, probes: bool = False, sentinels: bool = False) \
-            -> tuple[float, float, object, object]:
+    def run(fused: bool, probes: bool = False, sentinels: bool = False,
+            chaos=None) -> tuple[float, float, object, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
-        sim = build_sim(X, y, fused, probes=probes, sentinels=sentinels)
+        sim = build_sim(X, y, fused, probes=probes, sentinels=sentinels,
+                        chaos=chaos)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
         # Warmup: trigger compilation of the scan (donate_state=False: the
@@ -257,6 +284,25 @@ def bench_ours(X, y) -> float:
               f"sentinels off)", file=sys.stderr)
     except Exception as e:  # the A/B must not kill the main measurement
         print(f"[bench] sentinels A/B failed ({e!r})", file=sys.stderr)
+    try:
+        # Chaos-layer overhead, measured the same way: the plain config
+        # with a scheduled partition + drop spike (simulation.faults),
+        # A/B'd against the chaos-off run above (which IS the default
+        # path — chaos=None compiles the identical program). ISSUE-7
+        # acceptance: < 5% on this config.
+        elapsed_c, _, _, _ = run(False, chaos=bench_chaos_config(n_rounds))
+        CHAOS_INFO.update({
+            "chaos_off_rounds_per_sec": round(n_rounds / elapsed, 2),
+            "chaos_on_rounds_per_sec": round(n_rounds / elapsed_c, 2),
+            "chaos_overhead_frac": round(
+                max(0.0, 1.0 - elapsed / elapsed_c), 4),
+        })
+        print(f"[bench] chaos on: {n_rounds} rounds in {elapsed_c:.2f}s "
+              f"({n_rounds / elapsed_c:.1f} r/s; overhead "
+              f"{CHAOS_INFO['chaos_overhead_frac']:.1%} vs chaos off)",
+              file=sys.stderr)
+    except Exception as e:  # the A/B must not kill the main measurement
+        print(f"[bench] chaos A/B failed ({e!r})", file=sys.stderr)
     stamp_wire_traffic(sim, report, n_rounds)
     emit_manifest(sim, f"north-star/{label}")
     return n_rounds / elapsed
@@ -1417,6 +1463,7 @@ def main():
             **WIRE_INFO,
             **PROBE_INFO,
             **SENTINEL_INFO,
+            **CHAOS_INFO,
             "ours_rounds_per_sec": round(ours, 2),
             "ours_rounds_measured": (BENCH_ROUNDS_DEGRADED if DEGRADED
                                      else BENCH_ROUNDS),

@@ -68,6 +68,34 @@ def add_sentinels_flag(p):
     return p
 
 
+def add_chaos_flag(p):
+    """Only for scripts that pass it through to their simulator."""
+    p.add_argument("--chaos", action="store_true",
+                   help="inject the demo fault scenario (docs/robustness.md):"
+                        " the population partitioned in half for the middle "
+                        "third of the run, then healed. Combine with "
+                        "--probes to get the partition consensus gap and "
+                        "rounds-to-reconverge in the summary")
+    return p
+
+
+def demo_chaos_config(args):
+    """The ``--chaos`` scenario: a half/half partition over the middle
+    third of the run (heal round recorded on ``args`` so :func:`finish`
+    can name rounds-to-reconverge). None when the flag is off."""
+    if not getattr(args, "chaos", False):
+        return None
+    from gossipy_tpu.simulation import ChaosConfig, PartitionEpisode
+    n, r = args.nodes, args.rounds
+    a = max(r // 3, 1)
+    b = max(2 * r // 3, a + 1)
+    args._chaos_heal = b
+    half = n // 2
+    return ChaosConfig(partitions=(PartitionEpisode(
+        components=(tuple(range(half)), tuple(range(half, n))),
+        start=a, stop=b),), horizon=r)
+
+
 def finish(report, args, local: bool = False, label: str = "final"):
     """Print a one-line JSON summary + optionally save the plot.
 
@@ -125,6 +153,23 @@ def finish(report, args, local: bool = False, label: str = "final"):
         if hwm is not None and len(hwm) and _np.isfinite(hwm[-1]):
             health["delta_hwm"] = round(float(hwm[-1]), 6)
         summary["health"] = health
+    cause = getattr(reports[0], "failed_per_cause", None) or {}
+    gap = getattr(reports[0], "chaos_component_gap", None)
+    if "chaos" in cause or (gap is not None and len(gap)):
+        # Scheduled-fault summary (runs started with chaos=).
+        import numpy as _np
+        chaos = {}
+        if "chaos" in cause:
+            chaos["failed_chaos"] = int(_np.sum(cause["chaos"]))
+        if gap is not None and len(gap):
+            chaos["gap_peak"] = round(float(_np.nanmax(gap)), 6)
+            chaos["gap_last"] = round(float(gap[-1]), 6)
+            heal = getattr(args, "_chaos_heal", None)
+            if heal is not None and heal < len(gap):
+                from gossipy_tpu.simulation import rounds_to_reconverge
+                chaos["rounds_to_reconverge"] = \
+                    rounds_to_reconverge(gap, heal)
+        summary["chaos"] = chaos
     print(json.dumps(summary))
     if args.plot:
         from gossipy_tpu.utils import plot_evaluation

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import optax
 
-from _common import add_probes_flag, add_sentinels_flag, make_parser, finish
+from _common import add_chaos_flag, add_probes_flag, add_sentinels_flag, \
+    demo_chaos_config, make_parser, finish
 
 from gossipy_tpu import set_seed
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology, \
@@ -30,6 +31,7 @@ def main():
                         default="uniform")
     add_probes_flag(parser)
     add_sentinels_flag(parser)
+    add_chaos_flag(parser)
     args = parser.parse_args()
     key = set_seed(args.seed)
 
@@ -53,7 +55,7 @@ def main():
         mixing=mix(topology),
         delta=100, protocol=AntiEntropyProtocol.PUSH,
         sampling_eval=0.1, sync=False, probes=args.probes,
-        sentinels=args.sentinels)
+        sentinels=args.sentinels, chaos=demo_chaos_config(args))
 
     state = simulator.init_nodes(key)
     state, report = simulator.start(state, n_rounds=args.rounds, key=key)

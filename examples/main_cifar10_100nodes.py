@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from _common import add_probes_flag, add_sentinels_flag, make_parser, finish
+from _common import add_chaos_flag, add_probes_flag, add_sentinels_flag, \
+    demo_chaos_config, make_parser, finish
 
 from gossipy_tpu import set_seed
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology
@@ -54,6 +55,7 @@ def main():
                              "dequantize-on-gather; merge math stays fp32)")
     add_probes_flag(parser)
     add_sentinels_flag(parser)
+    add_chaos_flag(parser)
     args = parser.parse_args()
     key = set_seed(args.seed)
 
@@ -97,7 +99,8 @@ def main():
         delta=100, protocol=AntiEntropyProtocol.PUSH,
         sampling_eval=0.1, sync=True, eval_every=args.eval_every,
         fused_merge=args.fused, history_dtype=args.history_dtype,
-        probes=args.probes, sentinels=args.sentinels)
+        probes=args.probes, sentinels=args.sentinels,
+        chaos=demo_chaos_config(args))
     budget = simulator.memory_budget()
     print(f"[cifar10-100nodes] history ring ({args.history_dtype}): "
           f"{budget['history_ring_bytes'] / 2**20:.1f} MB "

@@ -222,6 +222,11 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
         sampling_eval=cfg.sampling_eval, sync=cfg.sync,
         eval_every=cfg.eval_every,
     )
+    if cfg.chaos is not None:
+        # Validated here (ChaosConfig.from_dict raises on unknown fields)
+        # so a typo'd chaos spec fails at build, not deep in a trace.
+        from .simulation.faults import ChaosConfig
+        common["chaos"] = ChaosConfig.from_dict(cfg.chaos)
     common.update(cfg.simulator_params)
     kind = cfg.simulator
     if kind == "gossip":
@@ -277,9 +282,13 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
 # separately by the packer, so a seed that DID change a shape still splits
 # the bucket); ``drop_prob``/``online_prob`` are traced per-tenant scalars
 # in the megabatch program; ``n_rounds``/``repetitions`` are host-side
-# run-length knobs outside the per-round trace.
+# run-length knobs outside the per-round trace. ``chaos`` is
+# tenant-variable in its schedule VALUES only — the compiled
+# FaultSchedule rides the tenant axis as data, while its array SHAPES
+# (and the static facts derived from the config: component count,
+# edge-mask form) are hashed separately by the packer and split buckets.
 TENANT_VARIABLE_FIELDS = ("seed", "drop_prob", "online_prob", "n_rounds",
-                          "repetitions")
+                          "repetitions", "chaos")
 
 
 @dataclasses.dataclass
@@ -339,6 +348,9 @@ class ExperimentConfig:
     delay_params: dict = dataclasses.field(default_factory=dict)
     drop_prob: float = 0.0
     online_prob: float = 1.0
+    chaos: Optional[dict] = None         # ChaosConfig.to_dict() form:
+                                         # scheduled outages/partitions/
+                                         # churn/spikes (simulation.faults)
     sampling_eval: float = 0.0
     sync: bool = True
     eval_every: int = 1

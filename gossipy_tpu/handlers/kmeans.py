@@ -8,14 +8,31 @@ Re-design of ``KMeansHandler`` (reference handler.py:579-639). Params = the
   write wins (handler.py:608-615). We move each centroid toward the *mean* of
   the samples assigned to it — deterministic and batch-size invariant.
 - ``matching="hungarian"`` (handler.py:626-630) calls scipy's Hungarian
-  solver on host; inside jit we use a greedy sequential assignment on the
-  pairwise distance matrix (optimal for well-separated centroids; O(k^3)).
+  solver on host. We split by execution context: EAGER merges (host-side
+  analysis, the flight recorder's ``jax.disable_jit`` phase localization,
+  direct ``handler.merge`` calls) use the EXACT solver
+  (:func:`exact_match`, ``scipy.optimize.linear_sum_assignment``);
+  TRACED merges (the jitted engines — and the sequential engine's jitted
+  single-node calls) use :func:`greedy_match`, a sequential
+  cheapest-pair assignment that stays inside jit.
+
+  The tradeoff, quantified in ``tests/test_handlers.py``
+  (``TestKMeansMatching``): greedy is exact whenever centroids are
+  well-separated relative to the inter-set drift (each centroid's true
+  partner is its global nearest — the typical gossip regime, where peers
+  train on samples of the same clusters), but on crafted cost matrices
+  it can exceed the optimal assignment cost by an unbounded factor
+  (locking a cheap pair that forces an expensive completion). Greedy is
+  O(k^3) like one Hungarian augmentation sweep and shape-static; the
+  exact solver is host-only. Both produce a permutation, so the merged
+  centroid count never changes — only WHICH pairs average.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import CreateModelMode
 from ..utils import nmi
@@ -24,7 +41,9 @@ from .base import BaseHandler, ModelState, PeerModel
 
 def greedy_match(cost: jax.Array) -> jax.Array:
     """Greedy linear assignment: repeatedly take the globally-cheapest
-    (row, col) pair. Returns for each row of ``cost`` the matched column."""
+    (row, col) pair. Returns for each row of ``cost`` the matched column.
+    Optimal for well-separated centroids; see the module doc (and
+    :func:`exact_match`) for the divergence contract."""
     k = cost.shape[0]
     big = jnp.inf
 
@@ -40,6 +59,18 @@ def greedy_match(cost: jax.Array) -> jax.Array:
     _, match = jax.lax.fori_loop(0, k, body,
                                  (cost, jnp.zeros((k,), dtype=jnp.int32)))
     return match
+
+
+def exact_match(cost) -> np.ndarray:
+    """Exact minimum-cost linear assignment (Hungarian algorithm via
+    ``scipy.optimize.linear_sum_assignment``). Host-side only — the
+    eager counterpart of :func:`greedy_match`. Returns for each row the
+    matched column (int32 [k])."""
+    from scipy.optimize import linear_sum_assignment
+    rows, cols = linear_sum_assignment(np.asarray(cost))
+    out = np.zeros(cost.shape[0], dtype=np.int32)
+    out[rows] = cols.astype(np.int32)
+    return out
 
 
 class KMeansHandler(BaseHandler):
@@ -75,13 +106,23 @@ class KMeansHandler(BaseHandler):
         c = jnp.where((counts > 0)[:, None], moved, c)
         return ModelState(c, (), state.n_updates + 1)
 
+    def _match(self, cost: jax.Array) -> jax.Array:
+        """Centroid assignment for a merge: exact Hungarian on the
+        host/eager path, greedy inside a trace (see module doc)."""
+        if isinstance(cost, jax.core.Tracer):
+            return greedy_match(cost)
+        try:
+            return jnp.asarray(exact_match(cost))
+        except ImportError:  # scipy unavailable: greedy everywhere
+            return greedy_match(cost)
+
     def merge(self, state: ModelState, peer: PeerModel, extra=None) -> ModelState:
         c1, c2 = state.params, peer.params
         if self.matching == "naive":
             c = (c1 + c2) / 2.0  # handler.py:624-625
         else:
             d2 = ((c1[:, None, :] - c2[None, :, :]) ** 2).sum(-1)
-            match = greedy_match(jnp.sqrt(d2))
+            match = self._match(jnp.sqrt(d2))
             c = (c1 + c2[match]) / 2.0  # handler.py:626-630
         return ModelState(c, (), jnp.maximum(state.n_updates, peer.n_updates))
 

@@ -92,6 +92,22 @@ def _data_shapes(data: dict) -> dict:
             for k, v in sorted(data.items())}
 
 
+def _chaos_shape(sim: Any) -> Optional[dict]:
+    """Trace-pinning chaos facts: the FaultSchedule's array shapes plus
+    the static config-derived constants the round program closes over
+    (component count for the segment reductions, the edge-mask form).
+    None for chaos-free simulators — and for engines predating the
+    chaos layer (getattr guards keep old pickles/subclasses packable)."""
+    if getattr(sim, "chaos", None) is None:
+        return None
+    from ..simulation.faults import schedule_shape_summary
+    return {
+        "schedule": schedule_shape_summary(sim.chaos_schedule),
+        "n_components": sim._chaos_ncomp,
+        "edge_form": sim._chaos_edge_form,
+    }
+
+
 def shape_signature(request: RunRequest, sim: Any) -> ShapeSignature:
     """The megabatch bucket key for a built run (see module doc for what
     it covers). Built-simulator facts are included on top of the config's
@@ -113,6 +129,11 @@ def shape_signature(request: RunRequest, sim: Any) -> ShapeSignature:
                       if sim.sentinels is not None else None),
         "topology": _topology_digest(sim.topology),
         "data_shapes": _data_shapes(sim.data),
+        # Chaos: schedule array SHAPES and the static trace facts split
+        # buckets; the schedule VALUES are tenant-variable and ride the
+        # batch axis (the scheduler rebinds sim.chaos_schedule per lane,
+        # like data and the fault rates).
+        "chaos_shape": _chaos_shape(sim),
     }
     digest = hashlib.sha1(
         json.dumps(fields, sort_keys=True, default=str).encode()

@@ -15,7 +15,7 @@ telemetry routing and failure handling live in a host-side control plane
   across buckets, state donated between slices so the [T, D, N, ...]
   history rings are never double-buffered);
 - **streams** per-tenant telemetry: each tenant gets its own JSONL event
-  stream (schema-v4 rows replayed per slice), its own
+  stream (schema-v5 rows replayed per slice), its own
   :class:`~gossipy_tpu.simulation.report.SimulationReport` and its own
   per-tenant :class:`~gossipy_tpu.telemetry.RunManifest` (fault
   rates/seed patched to the TENANT's values, bucket + signature + the
@@ -82,6 +82,16 @@ class _BucketRuntime:
                                 jnp.float32)
         self.online = jnp.asarray(
             [r.request.config.online_prob for r in runs], jnp.float32)
+        # Chaos schedules are tenant data: same SHAPES within a bucket
+        # (the signature's chaos_shape guarantees it), VALUES stacked on
+        # the tenant axis and rebound per lane inside the step trace.
+        self.chaos_on = getattr(self.sim, "chaos", None) is not None
+        if self.chaos_on:
+            self.chaos_scheds = jax.tree.map(
+                lambda *ls: jnp.stack(ls),
+                *[r.sim.chaos_schedule for r in runs])
+        else:  # structure-stable dummy lane input, like hc w/o sentinels
+            self.chaos_scheds = jnp.zeros((len(runs),), jnp.int32)
         self.requested = [r.request.rounds for r in runs]
         self.total_rounds = max(self.requested)
         self.n_slices = math.ceil(self.total_rounds / self.slice_rounds)
@@ -140,16 +150,22 @@ class _BucketRuntime:
         sim = self.sim
         chunk = self.slice_rounds
         sentinels_on = self.sentinels_on
+        chaos_on = self.chaos_on
 
-        def step_one(state, key, data, drop, online, hc):
+        def step_one(state, key, data, drop, online, hc, chaos_sched):
             # Rebind the per-tenant lane values onto the representative
             # simulator for the duration of the trace (the _make_run
             # pattern, extended to the fault rates — bernoulli takes a
-            # traced p, so tenants in one program may differ in them).
-            saved = (sim.data, sim.drop_prob, sim.online_prob)
+            # traced p, so tenants in one program may differ in them —
+            # and to the chaos schedule tables, whose per-round gathers
+            # take traced operands just as well).
+            saved = (sim.data, sim.drop_prob, sim.online_prob,
+                     getattr(sim, "chaos_schedule", None))
             sim.data = data
             sim.drop_prob = drop
             sim.online_prob = online
+            if chaos_on:
+                sim.chaos_schedule = chaos_sched
             try:
                 last = state.round + chunk - 1
 
@@ -171,7 +187,8 @@ class _BucketRuntime:
                     return final[0], final[1], stats
                 return final, hc, stats
             finally:
-                sim.data, sim.drop_prob, sim.online_prob = saved
+                (sim.data, sim.drop_prob, sim.online_prob,
+                 sim.chaos_schedule) = saved
 
         # Donate the state batch: the [T, D, N, ...] history rings are the
         # dominant term and each slice's input is dead once the next
@@ -222,7 +239,7 @@ class _BucketRuntime:
             try:
                 self.states, self.hc, stats = self._step_fn(
                     self.states, self.keys, self.data, self.drop,
-                    self.online, self.hc)
+                    self.online, self.hc, self.chaos_scheds)
                 host = jax.tree.map(np.asarray, stats)
             except Exception as e:  # the whole bucket program died
                 self._fail_all(e, chunk_start)

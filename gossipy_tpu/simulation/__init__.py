@@ -1,6 +1,16 @@
 """Simulation runtime (reference gossipy/simul.py re-designed for TPU)."""
 
 from .engine import GossipSimulator, Mailbox, SimState
+from .faults import (
+    ChaosConfig,
+    ChurnProcess,
+    FaultSchedule,
+    FaultSpike,
+    OutageEpisode,
+    PartitionEpisode,
+    build_fault_schedule,
+    rounds_to_reconverge,
+)
 from .events import (
     CallbackReceiver,
     JSONLinesReceiver,
@@ -33,4 +43,7 @@ __all__ = [
     "SimulationEventReceiver", "SimulationEventSender", "ProgressReceiver",
     "JSONLinesReceiver", "CallbackReceiver",
     "SequentialGossipSimulator", "SeqState", "MessageRecord",
+    "ChaosConfig", "OutageEpisode", "PartitionEpisode", "ChurnProcess",
+    "FaultSpike", "FaultSchedule", "build_fault_schedule",
+    "rounds_to_reconverge",
 ]

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import AntiEntropyProtocol, ConstantDelay, CreateModelMode, \
-    Delay, MessageType, Topology
+    Delay, MessageType, Topology, sample_peers
 from ..handlers.base import BaseHandler, ModelState, PeerModel
 from ..telemetry import (
     PHASE_EVAL,
@@ -78,6 +78,12 @@ from ..telemetry.probes import (
     sq_param_distance,
 )
 from .events import SimulationEventSender
+from .faults import (
+    CHAOS_PROBE_KEYS,
+    ChaosConfig,
+    build_fault_schedule,
+    chaos_round_stats,
+)
 from .report import SimulationReport
 
 # Purpose tags for PRNG key folding (one stream per (round, purpose)).
@@ -397,6 +403,26 @@ class GossipSimulator(SimulationEventSender):
         moment a round trips) and are stamped into the run manifest.
         Pair with :class:`~gossipy_tpu.telemetry.FlightRecorder` to
         capture a deterministically replayable repro bundle on anomaly.
+    chaos : ChaosConfig | dict | None
+        Opt-in scheduled fault injection (:mod:`.faults`): correlated
+        outage episodes (node groups forced fully offline — no sends, no
+        receives — for contiguous round windows), network partitions and
+        edge churn (per-round edge-alive masks over the static base
+        adjacency, so compiled shapes never change), and
+        piecewise-constant ``drop_prob`` / delay-scale spikes. The
+        declarative config compiles at construction into a shape-static
+        :class:`~gossipy_tpu.simulation.faults.FaultSchedule` the jitted
+        round program indexes by the traced absolute round number.
+        Delivery failures on forced-offline receivers get their own
+        ``"chaos"`` failure cause (the legacy ``failed`` total stays the
+        exact cause sum); with consensus probes also enabled, the round
+        stats gain the partition-recovery vitals
+        (``chaos_component_gap`` / ``chaos_within_mean`` /
+        ``chaos_active_components``). ``None`` (default) traces the
+        exact same program as before the feature. Partitions/churn sever
+        links at SEND time; in-flight messages still drain. Variants
+        overriding ``_select_peers`` (PENS) cannot take edge faults and
+        raise at construction.
     """
 
     # Out-of-tree subclasses that override ``_decode_extra`` or
@@ -439,7 +465,8 @@ class GossipSimulator(SimulationEventSender):
                  max_fires_per_round: Optional[int] = None,
                  history_dtype: str = "float32",
                  probes: Union[None, bool, ProbeConfig] = None,
-                 sentinels: Union[None, bool, SentinelConfig] = None):
+                 sentinels: Union[None, bool, SentinelConfig] = None,
+                 chaos: Union[None, dict, ChaosConfig] = None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -586,6 +613,40 @@ class GossipSimulator(SimulationEventSender):
             and all(getattr(type(self), hook)
                     is getattr(GossipSimulator, hook)
                     for hook in ("_apply_receive", "_receive_rows")))
+        # Scheduled fault injection (simulation.faults): None = strictly
+        # no chaos code in the trace (same discipline as probes and
+        # sentinels — the default round program is byte-identical to the
+        # pre-feature one). The declarative config compiles here into a
+        # shape-static schedule the round program indexes by the traced
+        # absolute round; the static facts that pin the TRACE (component
+        # count, edge-mask form) live on the simulator, the per-round
+        # VALUES live in ``chaos_schedule`` — which the service scheduler
+        # rebinds per tenant lane, like data and the fault rates.
+        self.chaos: Optional[ChaosConfig] = ChaosConfig.coerce(chaos)
+        self.chaos_schedule = None
+        self._chaos_edge_form: Optional[str] = None
+        self._chaos_ncomp = 1
+        if self.chaos is not None:
+            sched_np = build_fault_schedule(self.chaos, topology,
+                                            self.drop_prob)
+            self.chaos_schedule = jax.tree.map(jnp.asarray, sched_np)
+            self._chaos_ncomp = self.chaos.max_components()
+            if self.chaos.has_edge_faults():
+                if type(self)._select_peers is not \
+                        GossipSimulator._select_peers and \
+                        type(self)._round is GossipSimulator._round:
+                    raise ValueError(
+                        f"{type(self).__name__} overrides _select_peers; "
+                        "chaos partitions/churn mask the BASE uniform "
+                        "peer sampling and would be silently bypassed — "
+                        "use outage/spike faults only, or drop chaos")
+                if isinstance(sched_np.edge_masks, np.ndarray):
+                    self._chaos_edge_form = "dense"
+                else:
+                    self._chaos_edge_form = "slot"
+                    from .nodes import build_neighbor_table
+                    self._chaos_nbr_table = jnp.asarray(
+                        build_neighbor_table(topology))
 
     # -- setup -------------------------------------------------------------
 
@@ -940,8 +1001,12 @@ class GossipSimulator(SimulationEventSender):
 
     def _history_depth(self, size: int) -> int:
         """Ring depth: enough rounds to cover the worst-case in-flight delay
-        for a message of ``size`` scalars."""
+        for a message of ``size`` scalars (including the worst scheduled
+        chaos delay spike, whose scale multiplies every sampled delay)."""
         max_d = self.delay.max_delay(size)
+        if self.chaos is not None:
+            import math
+            max_d = int(math.ceil(max_d * self.chaos.max_delay_scale()))
         # send offset <= delta-1, plus delay, plus one reply delay leg.
         return max(2, (self.delta - 1 + 2 * max_d) // self.delta + 2)
 
@@ -1061,8 +1126,13 @@ class GossipSimulator(SimulationEventSender):
         return jnp.zeros(self.n_nodes, dtype=jnp.int32)
 
     def _select_peers(self, state: SimState, base_key, r) -> jax.Array:
-        """One peer per node (overridden e.g. by PENS peer selection)."""
-        return self.topology.sample_peers(self._round_key(base_key, r, _K_PEER))
+        """One peer per node (overridden e.g. by PENS peer selection).
+        With chaos partitions/churn scheduled, the draw runs over the
+        round's alive-edge mask instead of the frozen adjacency."""
+        key = self._round_key(base_key, r, _K_PEER)
+        if self.chaos is not None and self._chaos_edge_form is not None:
+            return self._chaos_masked_peers(key, r)
+        return self.topology.sample_peers(key)
 
     def _send_gate(self, state: SimState, active, peers, base_key, r):
         """Hook gating sends (token-account flow control, PENS selection
@@ -1083,7 +1153,7 @@ class GossipSimulator(SimulationEventSender):
         msg_type = PROTO_TO_MSG[self.protocol]
 
         n_sent = jnp.int32(0)
-        fails = FailureCounts.zeros()
+        fails = self._fc_zeros()
         # Sub-fires: async nodes whose period fits multiple times in the
         # round window send once per multiple (all from the round-start
         # snapshot). F is 1 for sync simulations, so f=0 reproduces the
@@ -1097,13 +1167,19 @@ class GossipSimulator(SimulationEventSender):
             # base key; sub-fires > 0 get a distinct base via _K_FIRE.
             fire_base = base_key if f == 0 else key_f(_K_FIRE)
             fires, offset = self._fire_mask(state, r, f)
+            if self.chaos is not None:
+                # A forced-offline node neither sends nor receives (a
+                # crashed process does neither) — unlike the independent
+                # online draw, which only gates receipt.
+                fires = fires & ~self._chaos_forced_offline(r)
             peers = self._select_peers(state, fire_base, r)
             active = fires & (peers >= 0)
             active, state = self._send_gate(state, active, peers, fire_base, r)
 
             dropped = jax.random.bernoulli(
-                key_f(_K_DROP), self.drop_prob, (n,))
-            delays = self.delay.sample(key_f(_K_DELAY), (n,), size)
+                key_f(_K_DROP), self._chaos_drop_prob(r), (n,))
+            delays = self._chaos_scale_delays(
+                self.delay.sample(key_f(_K_DELAY), (n,), size), r)
             dr = (offset + delays) // self.delta
 
             extra = self._send_extra(key_f(_K_EXTRA), state)
@@ -1335,6 +1411,73 @@ class GossipSimulator(SimulationEventSender):
         jax.lax.cond(stats["health_trip"] > 0, fire,
                      lambda: jnp.int32(0))
 
+    # -- chaos (opt-in; see simulation.faults) ------------------------------
+
+    def _fc_zeros(self) -> FailureCounts:
+        """Zero failure counters matching this simulator's cause set: the
+        fourth (``chaos``) counter leaf exists only when chaos is
+        configured, so chaos-free scan carries keep the pre-feature
+        pytree structure (and HLO)."""
+        return FailureCounts.zeros(chaos_on=self.chaos is not None)
+
+    def _chaos_t(self, r):
+        """Clamped schedule row for the traced absolute round ``r``
+        (rounds at/after the horizon read the trailing baseline row)."""
+        return jnp.clip(r, 0, self.chaos_schedule.rows - 1)
+
+    def _chaos_forced_offline(self, r) -> jax.Array:
+        """[N] bool: nodes a scheduled outage forces fully offline at
+        round ``r`` (no sends, no receives)."""
+        return self.chaos_schedule.forced_offline[self._chaos_t(r)]
+
+    def _chaos_drop_prob(self, r):
+        """The round's message drop rate: the static base rate, or the
+        schedule's per-round (possibly spiked) traced scalar."""
+        if self.chaos is None:
+            return self.drop_prob
+        return self.chaos_schedule.drop_prob[self._chaos_t(r)]
+
+    def _chaos_scale_delays(self, delays: jax.Array, r) -> jax.Array:
+        """Apply the round's scheduled delay-scale spike (identity trace
+        when chaos is off)."""
+        if self.chaos is None:
+            return delays
+        s = self.chaos_schedule.delay_scale[self._chaos_t(r)]
+        return jnp.floor(delays.astype(jnp.float32) * s).astype(jnp.int32)
+
+    def _chaos_masked_peers(self, key: jax.Array, r) -> jax.Array:
+        """Uniform peer draw over the round's ALIVE adjacency (base
+        adjacency AND the scheduled partition/churn edge mask). Dense
+        topologies mask the [N, N] categorical; sparse ones draw over
+        the padded neighbor-slot table with the O(E) per-edge mask
+        gathered for this round. Nodes whose every edge is dead get peer
+        -1 (their send is skipped, like isolated nodes)."""
+        sched = self.chaos_schedule
+        m = sched.mask_idx[self._chaos_t(r)]
+        if self._chaos_edge_form == "dense":
+            adj = self.topology.adjacency_dev & sched.edge_masks[m]
+            return sample_peers(key, adj)
+        nbr = self._chaos_nbr_table
+        alive = sched.slot_masks[m] & (nbr >= 0)
+        logits = jnp.where(alive, 0.0, -jnp.inf)
+        slot = jax.random.categorical(key, logits, axis=-1)
+        has = alive.any(axis=-1)
+        peers = nbr[jnp.arange(self.n_nodes),
+                    jnp.clip(slot, 0, nbr.shape[1] - 1)]
+        return jnp.where(has, peers, -1).astype(jnp.int32)
+
+    def _chaos_probes_on(self) -> bool:
+        """Static: whether the round emits the partition-recovery vitals
+        (chaos scheduled AND consensus probes enabled — the gap/mixing
+        math is consensus-style)."""
+        return (self.chaos is not None and self.probes is not None
+                and self.probes.consensus)
+
+    def _chaos_stats(self, state: SimState, r) -> dict:
+        comp = self.chaos_schedule.component_id[self._chaos_t(r)]
+        return chaos_round_stats(state.model.params, comp,
+                                 self._chaos_ncomp)
+
     # -- probes (opt-in; see telemetry.probes) ------------------------------
 
     def _probe_slots_on(self) -> bool:
@@ -1418,6 +1561,9 @@ class GossipSimulator(SimulationEventSender):
         b = r % D
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE), self.online_prob, (n,))
+        if self.chaos is not None:
+            forced = self._chaos_forced_offline(r)
+            online = online & ~forced
         size = self._model_size(state.model.params)
         # Mailbox occupancy high-water mark of the cell being drained: the
         # fullest receiver's slot count this round (a per-round headroom
@@ -1447,8 +1593,17 @@ class GossipSimulator(SimulationEventSender):
             extra = jnp.take(state.mailbox.extra[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
-            fails = fails._replace(
-                offline=fails.offline + (occupied & ~online).sum())
+            if self.chaos is not None:
+                # Forced-offline receivers get the scheduled-fault cause;
+                # the random availability draw keeps "offline". Mutually
+                # exclusive per message, so the cause sum stays exact.
+                fails = fails.add_chaos((occupied & forced).sum())
+                fails = fails._replace(
+                    offline=fails.offline
+                    + (occupied & ~forced & ~online).sum())
+            else:
+                fails = fails._replace(
+                    offline=fails.offline + (occupied & ~online).sum())
 
             carries_model = (ty == MessageType.PUSH) | \
                             (ty == MessageType.PUSH_PULL) | \
@@ -1495,8 +1650,9 @@ class GossipSimulator(SimulationEventSender):
                 rkey = self._round_key(base_key, r, _K_REPLY_DELAY * 101 + k)
                 rdrop = jax.random.bernoulli(
                     self._round_key(base_key, r, _K_REPLY_DROP * 101 + k),
-                    self.drop_prob, (n,))
-                rdelay = self.delay.sample(rkey, (n,), size)
+                    self._chaos_drop_prob(r), (n,))
+                rdelay = self._chaos_scale_delays(
+                    self.delay.sample(rkey, (n,), size), r)
                 rdr = rdelay // self.delta
                 n_sent_replies += reply_needed.sum()
                 reply_size_total += reply_needed.sum() * size
@@ -1524,7 +1680,7 @@ class GossipSimulator(SimulationEventSender):
                 out = out + (first_bad,)
             return out
 
-        init = (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0),
+        init = (state, self._fc_zeros(), jnp.int32(0), jnp.int32(0),
                 jnp.int32(0), jnp.int32(0))
         if probes_on:
             init = init + (self._probe_zero_accum(),)
@@ -1580,12 +1736,15 @@ class GossipSimulator(SimulationEventSender):
             diag = {"compact_slots": jnp.int32(0), "wide_slots": jnp.int32(0)}
             if probes_on:
                 diag["probe_accum"] = self._probe_zero_accum()
-            return state, FailureCounts.zeros(), diag
+            return state, self._fc_zeros(), diag
         n = self.n_nodes
         D = state.history_ages.shape[0]
         b = r % D
         online = jax.random.bernoulli(
             self._round_key(base_key, r, _K_ONLINE * 7 + 3), self.online_prob, (n,))
+        if self.chaos is not None:
+            forced = self._chaos_forced_offline(r)
+            online = online & ~forced
         def slot_body(k, carry):
             if probes_on:
                 state, fails, n_compact, n_wide, pa = carry
@@ -1595,8 +1754,14 @@ class GossipSimulator(SimulationEventSender):
             sender = jnp.take(state.reply_box.sender[b], k, axis=1)
             occupied = sender >= 0
             valid = occupied & online
-            fails = fails._replace(
-                offline=fails.offline + (occupied & ~online).sum())
+            if self.chaos is not None:
+                fails = fails.add_chaos((occupied & forced).sum())
+                fails = fails._replace(
+                    offline=fails.offline
+                    + (occupied & ~forced & ~online).sum())
+            else:
+                fails = fails._replace(
+                    offline=fails.offline + (occupied & ~online).sum())
             sr_k = jnp.take(state.reply_box.send_round[b], k, axis=1)
             extra_k = jnp.take(state.reply_box.extra[b], k, axis=1)
             call_key = self._round_key(base_key, r, (_K_CALL + 53) * 101 + k)
@@ -1617,7 +1782,7 @@ class GossipSimulator(SimulationEventSender):
             out = (state, fails, n_compact, n_wide)
             return out + ((pa,) if probes_on else ())
 
-        init = (state, FailureCounts.zeros(), jnp.int32(0), jnp.int32(0))
+        init = (state, self._fc_zeros(), jnp.int32(0), jnp.int32(0))
         if probes_on:
             init = init + (self._probe_zero_accum(),)
         carry = jax.lax.fori_loop(0, self.Kr, slot_body, init)
@@ -1749,6 +1914,10 @@ class GossipSimulator(SimulationEventSender):
             "local": local,
             "global": glob,
         }
+        if self.chaos is not None:
+            stats["failed_chaos"] = fails.chaos
+            if self._chaos_probes_on():
+                stats.update(self._chaos_stats(state, r))
         if self.probes is not None:
             pa = None
             if self._probe_slots_on():
@@ -1770,11 +1939,14 @@ class GossipSimulator(SimulationEventSender):
         ``_live_round_times`` — the basis for the report's per-round timing
         and rounds/sec EMA when the run is live."""
         names = self._metric_keys()
-        # Probe and health values ride the same ordered callback (fixed
-        # key order so the host side can rebuild the dicts from
+        # Probe, health and chaos values ride the same ordered callback
+        # (fixed key order so the host side can rebuild the dicts from
         # positional operands).
+        from .faults import chaos_event_row
         probe_keys = [k for k in PROBE_STAT_KEYS if k in stats]
         health_keys = [k for k in HEALTH_STAT_KEYS if k in stats]
+        chaos_keys = [k for k in ("failed_chaos",) + CHAOS_PROBE_KEYS
+                      if k in stats]
 
         def cb(rnd, sent, failed, drop, offline, overflow, size, local,
                glob, *extra_vals):
@@ -1786,8 +1958,15 @@ class GossipSimulator(SimulationEventSender):
                       "overflow": int(overflow)}
             probes = probe_event_row(
                 dict(zip(probe_keys, extra_vals[:len(probe_keys)])))
+            off = len(probe_keys)
             health = health_event_row(
-                dict(zip(health_keys, extra_vals[len(probe_keys):])))
+                dict(zip(health_keys, extra_vals[off:off
+                                                 + len(health_keys)])))
+            off += len(health_keys)
+            chaos_vals = dict(zip(chaos_keys, extra_vals[off:]))
+            if "failed_chaos" in chaos_vals:
+                causes["chaos"] = int(chaos_vals["failed_chaos"])
+            chaos = chaos_event_row(chaos_vals)
 
             def row(vals):
                 if np.all(np.isnan(vals)):
@@ -1795,14 +1974,16 @@ class GossipSimulator(SimulationEventSender):
                 return {k: float(v) for k, v in zip(names, vals)}
             self._notify_round(int(rnd), int(sent), int(failed), int(size),
                                row(local), row(glob), live_only=True,
-                               causes=causes, probes=probes, health=health)
+                               causes=causes, probes=probes, health=health,
+                               chaos=chaos)
 
         jax.experimental.io_callback(
             cb, None, state.round, stats["sent"], stats["failed"],
             stats["failed_drop"], stats["failed_offline"],
             stats["failed_overflow"], stats["size"], stats["local"],
             stats["global"], *[stats[k] for k in probe_keys],
-            *[stats[k] for k in health_keys], ordered=True)
+            *[stats[k] for k in health_keys],
+            *[stats[k] for k in chaos_keys], ordered=True)
 
     def _cache_salt(self):
         """Extra jit-cache key component for variants whose trace depends on
@@ -2009,8 +2190,11 @@ class GossipSimulator(SimulationEventSender):
             failed_by_cause = {"drop": np.asarray(stats["failed_drop"]),
                                "offline": np.asarray(stats["failed_offline"]),
                                "overflow": np.asarray(stats["failed_overflow"])}
+            if "failed_chaos" in stats:
+                failed_by_cause["chaos"] = np.asarray(stats["failed_chaos"])
         extras = {k: opt(k) for k in PROBE_STAT_KEYS if k in stats}
         extras.update({k: opt(k) for k in HEALTH_STAT_KEYS if k in stats})
+        extras.update({k: opt(k) for k in CHAOS_PROBE_KEYS if k in stats})
         if self.probes is not None:
             if self.probes.consensus:
                 extras["probe_layer_names"] = self._probe_layer_names()

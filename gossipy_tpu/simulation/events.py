@@ -84,6 +84,15 @@ class SimulationEventReceiver:
         :class:`~gossipy_tpu.telemetry.SentinelConfig`. Fired after
         ``update_probes``, live and replayed alike."""
 
+    def update_chaos(self, round: int, chaos: dict) -> None:
+        """Per-round scheduled-fault recovery vitals (fired only by runs
+        with ``chaos=`` enabled; see :mod:`gossipy_tpu.simulation.faults`).
+        ``chaos`` carries the JSON-able per-round summary — subsets of
+        ``component_gap``/``within_mean``/``active_components`` (when
+        consensus probes are also on) and ``failed_chaos`` (the
+        scheduled-fault failure cause). Fired after ``update_health``,
+        live and replayed alike."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""
@@ -123,7 +132,8 @@ class SimulationEventSender:
                       include_live: bool = False,
                       causes: Optional[dict] = None,
                       probes: Optional[dict] = None,
-                      health: Optional[dict] = None) -> None:
+                      health: Optional[dict] = None,
+                      chaos: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
@@ -136,6 +146,8 @@ class SimulationEventSender:
                 r.update_probes(round, probes)
             if health is not None:
                 r.update_health(round, health)
+            if chaos is not None:
+                r.update_chaos(round, chaos)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -168,12 +180,18 @@ class SimulationEventSender:
         if "failed_drop" in stats:
             cause_arrs = {c: np.asarray(stats["failed_" + c])
                           for c in ("drop", "offline", "overflow")}
+            if "failed_chaos" in stats:
+                cause_arrs["chaos"] = np.asarray(stats["failed_chaos"])
         from ..telemetry.health import HEALTH_STAT_KEYS, health_event_row
         from ..telemetry.probes import PROBE_STAT_KEYS, probe_event_row
+        from .faults import CHAOS_PROBE_KEYS, chaos_event_row
         probe_arrs = {k: np.asarray(stats[k]) for k in PROBE_STAT_KEYS
                       if k in stats}
         health_arrs = {k: np.asarray(stats[k]) for k in HEALTH_STAT_KEYS
                        if k in stats}
+        chaos_arrs = {k: np.asarray(stats[k])
+                      for k in ("failed_chaos",) + CHAOS_PROBE_KEYS
+                      if k in stats}
 
         def row(arr, i):
             vals = arr[i]
@@ -187,11 +205,12 @@ class SimulationEventSender:
             probes = probe_event_row({k: a[i] for k, a in probe_arrs.items()})
             health = health_event_row(
                 {k: a[i] for k, a in health_arrs.items()})
+            chaos = chaos_event_row({k: a[i] for k, a in chaos_arrs.items()})
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
                                include_live=include_live, causes=causes,
-                               probes=probes, health=health)
+                               probes=probes, health=health, chaos=chaos)
         if fire_end:
             self._notify_end()
 
@@ -283,6 +302,9 @@ class CallbackReceiver(SimulationEventReceiver):
     def update_health(self, round, health):
         self._row["health"] = dict(health)
 
+    def update_chaos(self, round, chaos):
+        self._row["chaos"] = dict(chaos)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = dict(metrics)
 
@@ -331,6 +353,14 @@ class JSONLinesReceiver(SimulationEventReceiver):
                                     ``mailbox_hwm_run``, ``trip`` per the
                                     run's ``SentinelConfig`` (null
                                     without ``sentinels=``)
+        v5      ``chaos``           scheduled-fault row ``| null``:
+                                    subsets of ``component_gap``,
+                                    ``within_mean``,
+                                    ``active_components``,
+                                    ``failed_chaos`` per the run's
+                                    ``ChaosConfig`` (null without
+                                    ``chaos=``; ``failed_by_cause`` also
+                                    gains a ``chaos`` key on such runs)
         ======= =================== =====================================
 
     Works replayed (default) or live (``live=True`` streams rows during the
@@ -343,7 +373,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
-    SCHEMA = 4
+    SCHEMA = 5
 
     def __init__(self, path: str, live: bool = False):
         import json
@@ -357,7 +387,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
         self._row = {"schema": self.SCHEMA, "round": round, "sent": sent,
                      "failed": failed, "failed_by_cause": None,
                      "size": size, "probes": None, "health": None,
-                     "local": None, "global": None}
+                     "chaos": None, "local": None, "global": None}
 
     def update_failure_causes(self, round, causes):
         self._row["failed_by_cause"] = dict(causes)
@@ -367,6 +397,9 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     def update_health(self, round, health):
         self._row["health"] = dict(health)
+
+    def update_chaos(self, round, chaos):
+        self._row["chaos"] = dict(chaos)
 
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics
@@ -379,7 +412,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     @classmethod
     def parse_line(cls, line: str) -> dict:
-        """Version-tolerant row reader: normalize a v1/v2/v3/v4 line into
+        """Version-tolerant row reader: normalize a v1..v5 line into
         the CURRENT schema's shape (fields a line's version predates come
         back null, unknown future fields pass through untouched). The one
         reader consumers should use instead of re-encoding the version
@@ -393,6 +426,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
             row.setdefault("probes", None)
         if schema < 4:
             row.setdefault("health", None)
+        if schema < 5:
+            row.setdefault("chaos", None)
         return row
 
     def close(self):

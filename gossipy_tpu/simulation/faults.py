@@ -1,0 +1,551 @@
+"""Chaos layer: scheduled fault injection for gossip simulations.
+
+The engines' built-in fault model — an i.i.d. per-message ``drop_prob``
+Bernoulli and a per-round ``online_prob`` availability draw over a frozen
+topology (reference core.py:311-389; engine.py ``_send_phase`` /
+``_deliver_phase``) — cannot express the failures that actually kill
+decentralized learning: correlated outages, network partitions, and churn
+that rewires edges. This module adds a declarative, *scheduled* fault
+plane on top of it:
+
+- :class:`ChaosConfig` — the JSON-able description of what goes wrong
+  when: :class:`OutageEpisode` (node groups forced offline for contiguous
+  round windows, replacing the independent availability draw while
+  scheduled), :class:`PartitionEpisode` (the graph split into components
+  for rounds ``[start, stop)`` then healed), :class:`ChurnProcess`
+  (per-epoch rewiring *within the static superset adjacency* — the
+  topology the simulator was built with — so compiled shapes never
+  change), and :class:`FaultSpike` (piecewise-constant per-round
+  overrides of ``drop_prob`` and a message-delay scale).
+
+- :func:`build_fault_schedule` — compiles a config into a
+  :class:`FaultSchedule`: a pure, shape-static pytree of per-round
+  tables the jitted round program indexes by the TRACED absolute round
+  number. The control plane stays host-side (the Podracer split,
+  PAPERS.md): all randomness and window arithmetic happens here at
+  build time; the in-loop work is a handful of gathers. Edge effects
+  (partitions + churn) compose into per-round edge-alive masks stored
+  as a small set of DEDUPLICATED masks plus a per-round index — dense
+  ``[M, N, N]`` over a :class:`~gossipy_tpu.core.Topology`, per-edge
+  ``[M, 2E]`` (CSR directed-edge order) plus a padded ``[M, N, max_deg]``
+  slot form over a :class:`~gossipy_tpu.core.SparseTopology`, so the
+  sparse in-loop update stays O(E).
+
+- :func:`chaos_round_stats` — the in-graph recovery evidence: per-round
+  partition consensus gap (max L2 distance between scheduled-component
+  mean parameter vectors), within-component mixing (mean distance of
+  each node from its OWN component's mean), and the live component
+  count. Engine-agnostic pure math, like the rest of the telemetry
+  helpers — the jitted engine, the All2All variant and the sequential
+  engine all compute it through this one function, so
+  jitted-vs-sequential chaos parity is testable.
+
+- :func:`rounds_to_reconverge` — host-side post-processing naming how
+  many rounds after a heal the consensus gap took to close.
+
+Everything is OPT-IN (``GossipSimulator(chaos=...)``): with the default
+``chaos=None`` the round program traces exactly as before — no schedule
+arrays, no extra stats keys, byte-identical HLO (tested, like
+probes/sentinels).
+
+Semantics notes (documented divergences, deliberate):
+
+- A forced-offline node neither SENDS nor RECEIVES while its window is
+  active (a crashed process does neither), unlike the engine's
+  ``online_prob`` draw which only gates receipt. Delivery failures on
+  forced-offline receivers are attributed to the ``"chaos"`` failure
+  cause; the random availability draw keeps the ``"offline"`` cause.
+- Partitions/churn sever links at SEND time (a sender never picks a dead
+  edge, and never counts a send toward one); messages already in flight
+  when a partition starts still drain — links die, mailboxes don't.
+- Rounds at or beyond the schedule ``horizon`` read a trailing baseline
+  row: no forced outages, all edges alive, base fault rates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Declarative config
+# ---------------------------------------------------------------------------
+
+def _check_window(start: int, stop: int, what: str) -> None:
+    if not (0 <= start < stop):
+        raise ValueError(f"{what} window must satisfy 0 <= start < stop, "
+                         f"got [{start}, {stop})")
+
+
+@dataclasses.dataclass(frozen=True)
+class OutageEpisode:
+    """A correlated outage: ``nodes`` are forced offline (no sends, no
+    receives) for rounds ``[start, stop)``, replacing the independent
+    per-round availability draw for those nodes while scheduled."""
+
+    nodes: tuple
+    start: int
+    stop: int
+
+    def __post_init__(self):
+        _check_window(self.start, self.stop, "outage")
+        object.__setattr__(self, "nodes", tuple(int(n) for n in self.nodes))
+        if not self.nodes:
+            raise ValueError("an outage episode needs at least one node")
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionEpisode:
+    """A network partition: for rounds ``[start, stop)`` only edges whose
+    endpoints share a component stay alive; the graph heals at ``stop``.
+    ``components`` are disjoint node-id groups; nodes listed in no group
+    form one implicit extra component. Overlapping partition windows:
+    the LAST episode in the config wins per round."""
+
+    components: tuple
+    start: int
+    stop: int
+
+    def __post_init__(self):
+        _check_window(self.start, self.stop, "partition")
+        comps = tuple(tuple(int(n) for n in c) for c in self.components)
+        object.__setattr__(self, "components", comps)
+        if len(comps) < 1:
+            raise ValueError("a partition needs at least one component")
+        seen: set = set()
+        for c in comps:
+            if seen & set(c):
+                raise ValueError("partition components must be disjoint")
+            seen |= set(c)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnProcess:
+    """Edge churn within the static superset adjacency: every ``period``
+    rounds of the window ``[start, stop)`` a fresh uniform subset of
+    ``keep_frac`` of the topology's (undirected) edges is drawn alive;
+    the rest are down until the next epoch. Deterministic per
+    ``(seed, epoch)``."""
+
+    keep_frac: float
+    start: int
+    stop: int
+    period: int = 1
+    seed: int = 0
+
+    def __post_init__(self):
+        _check_window(self.start, self.stop, "churn")
+        if not 0.0 <= self.keep_frac <= 1.0:
+            raise ValueError(f"keep_frac must be in [0, 1], got "
+                             f"{self.keep_frac}")
+        if self.period < 1:
+            raise ValueError("churn period must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpike:
+    """A piecewise-constant fault-rate override for rounds
+    ``[start, stop)``: ``drop_prob`` replaces the simulator's base
+    per-message drop rate (None = keep the base), ``delay_scale``
+    multiplies every sampled message delay (floor-rounded)."""
+
+    start: int
+    stop: int
+    drop_prob: Optional[float] = None
+    delay_scale: float = 1.0
+
+    def __post_init__(self):
+        _check_window(self.start, self.stop, "spike")
+        if self.drop_prob is not None and not 0.0 <= self.drop_prob <= 1.0:
+            raise ValueError(f"spike drop_prob must be in [0, 1], got "
+                             f"{self.drop_prob}")
+        if self.delay_scale <= 0.0:
+            raise ValueError("delay_scale must be > 0")
+
+
+_EPISODE_KINDS = {"outages": OutageEpisode, "partitions": PartitionEpisode,
+                  "spikes": FaultSpike}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """A full chaos scenario: which faults hit which rounds.
+
+    ``horizon`` bounds the schedule tables (rounds beyond it are
+    baseline); None derives it as the max ``stop`` over every episode.
+    JSON-able via :meth:`to_dict` / :meth:`from_dict` — the form
+    :class:`~gossipy_tpu.config.ExperimentConfig` carries in its
+    ``chaos`` field.
+    """
+
+    outages: tuple = ()
+    partitions: tuple = ()
+    churn: Optional[ChurnProcess] = None
+    spikes: tuple = ()
+    horizon: Optional[int] = None
+
+    def __post_init__(self):
+        for name, cls in _EPISODE_KINDS.items():
+            eps = tuple(ep if isinstance(ep, cls) else cls(**ep)
+                        for ep in getattr(self, name))
+            object.__setattr__(self, name, eps)
+        if self.churn is not None and not isinstance(self.churn,
+                                                     ChurnProcess):
+            object.__setattr__(self, "churn", ChurnProcess(**self.churn))
+        if not (self.outages or self.partitions or self.churn is not None
+                or self.spikes):
+            raise ValueError("an empty ChaosConfig schedules nothing; pass "
+                             "chaos=None instead")
+        stops = [ep.stop for ep in self.outages + self.partitions
+                 + self.spikes]
+        if self.churn is not None:
+            stops.append(self.churn.stop)
+        derived = max(stops)
+        if self.horizon is None:
+            object.__setattr__(self, "horizon", derived)
+        elif self.horizon < derived:
+            raise ValueError(f"horizon {self.horizon} does not cover the "
+                             f"latest episode stop {derived}")
+
+    # -- coercion / serialization -------------------------------------------
+
+    @classmethod
+    def coerce(cls, chaos: Union[None, dict, "ChaosConfig"]
+               ) -> Optional["ChaosConfig"]:
+        """Normalize the ``chaos=`` constructor argument: ``None`` → off,
+        a dict → :meth:`from_dict`, a :class:`ChaosConfig` → itself."""
+        if chaos is None:
+            return None
+        if isinstance(chaos, cls):
+            return chaos
+        if isinstance(chaos, dict):
+            return cls.from_dict(chaos)
+        raise TypeError(f"chaos= expects None, dict or ChaosConfig; got "
+                        f"{type(chaos).__name__}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown chaos fields: {sorted(unknown)}; "
+                             f"valid: {sorted(known)}")
+        return cls(**d)
+
+    # -- static facts the engines need at construction ----------------------
+
+    def max_delay_scale(self) -> float:
+        """Worst-case delay multiplier (sizes the history ring)."""
+        return max([1.0] + [sp.delay_scale for sp in self.spikes])
+
+    def max_components(self) -> int:
+        """Static component count for the in-graph chaos stats: the max
+        over partition windows of (listed components + the implicit
+        unlisted group), floor 1."""
+        return max([1] + [len(p.components) + 1 for p in self.partitions])
+
+    def has_edge_faults(self) -> bool:
+        return bool(self.partitions) or self.churn is not None
+
+    def active_at(self, round_idx: int) -> list:
+        """The fault windows active at absolute round ``round_idx`` as
+        JSON-able dicts — what a flight-recorder bundle verdict names
+        when a chaos-scenario run trips a sentinel."""
+        r = int(round_idx)
+        out = []
+        for ep in self.outages:
+            if ep.start <= r < ep.stop:
+                out.append({"kind": "outage", "start": ep.start,
+                            "stop": ep.stop, "nodes": list(ep.nodes)})
+        for ep in self.partitions:
+            if ep.start <= r < ep.stop:
+                out.append({"kind": "partition", "start": ep.start,
+                            "stop": ep.stop,
+                            "components": [list(c) for c in ep.components]})
+        if self.churn is not None and \
+                self.churn.start <= r < self.churn.stop:
+            out.append({"kind": "churn", "start": self.churn.start,
+                        "stop": self.churn.stop,
+                        "keep_frac": self.churn.keep_frac,
+                        "period": self.churn.period})
+        for sp in self.spikes:
+            if sp.start <= r < sp.stop:
+                out.append({"kind": "spike", "start": sp.start,
+                            "stop": sp.stop, "drop_prob": sp.drop_prob,
+                            "delay_scale": sp.delay_scale})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The compiled schedule
+# ---------------------------------------------------------------------------
+
+class FaultSchedule(NamedTuple):
+    """Shape-static per-round fault tables, indexed by the traced absolute
+    round number clamped to the trailing baseline row (``horizon``). Every
+    field is an array leaf (or the empty-pytree ``()``), so the whole
+    schedule stacks/vmaps cleanly — the service megabatch rides tenants'
+    schedule VALUES on the batch axis while the SHAPES are part of the
+    bucket signature.
+
+    ``edge_masks`` (dense topologies) / ``csr_masks`` + ``slot_masks``
+    (sparse topologies) hold the deduplicated edge-alive masks;
+    ``mask_idx[t]`` picks the round's mask (0 = baseline, everything
+    alive). Masks are modifiers: the engine ANDs them with the base
+    adjacency, so a True entry on a non-edge is inert.
+    """
+
+    forced_offline: Any   # [T+1, N] bool: node scheduled offline this round
+    drop_prob: Any        # [T+1] f32: per-round message drop rate
+    delay_scale: Any      # [T+1] f32: per-round delay multiplier
+    mask_idx: Any         # [T+1] i32: edge-mask index (0 = baseline)
+    component_id: Any     # [T+1, N] i32: scheduled partition component
+    edge_masks: Any = ()  # [M, N, N] bool (dense topology) | ()
+    csr_masks: Any = ()   # [M, 2E] bool, CSR directed-edge order | ()
+    slot_masks: Any = ()  # [M, N, max_deg] bool, padded neighbor slots | ()
+
+    @property
+    def rows(self) -> int:
+        return self.forced_offline.shape[0]
+
+
+def schedule_shape_summary(sched: FaultSchedule) -> dict:
+    """Shapes/dtypes of a schedule's arrays — the part of a chaos config
+    that pins the compiled program (the service packer buckets on this;
+    the VALUES ride the tenant axis)."""
+    out = {}
+    for name, v in sched._asdict().items():
+        out[name] = (None if isinstance(v, tuple)
+                     else [list(np.shape(v)), str(np.asarray(v).dtype)])
+    return out
+
+
+def _undirected_pairs(topology):
+    """(pi, pj) int64 arrays of the topology's undirected edges, sorted
+    lexicographically — the canonical pair ordering every churn draw and
+    mask form derives from, identical for dense and CSR topologies."""
+    from ..core import SparseTopology
+    if isinstance(topology, SparseTopology):
+        src = np.repeat(np.arange(topology.num_nodes, dtype=np.int64),
+                        np.asarray(topology.degrees, dtype=np.int64))
+        dst = topology.indices.astype(np.int64)
+        keep = src < dst
+        pi, pj = src[keep], dst[keep]
+    else:
+        pi, pj = np.nonzero(np.triu(np.asarray(topology.adjacency)))
+        pi, pj = pi.astype(np.int64), pj.astype(np.int64)
+    order = np.lexsort((pj, pi))
+    return pi[order], pj[order]
+
+
+def build_fault_schedule(cfg: ChaosConfig, topology,
+                         base_drop_prob: float) -> FaultSchedule:
+    """Compile ``cfg`` against a topology into host-side numpy tables
+    (the jitted engines convert the leaves to device arrays; the
+    sequential engine consumes the numpy directly)."""
+    from ..core import SparseTopology
+    T = int(cfg.horizon)
+    n = topology.num_nodes
+    rows = T + 1  # trailing baseline row, read by rounds >= horizon
+
+    forced = np.zeros((rows, n), dtype=bool)
+    for ep in cfg.outages:
+        forced[ep.start:min(ep.stop, T), list(ep.nodes)] = True
+
+    drop = np.full(rows, float(base_drop_prob), dtype=np.float32)
+    scale = np.ones(rows, dtype=np.float32)
+    for sp in cfg.spikes:
+        sl = slice(sp.start, min(sp.stop, T))
+        if sp.drop_prob is not None:
+            drop[sl] = sp.drop_prob
+        scale[sl] = sp.delay_scale
+
+    # Component ids PERSIST past the partition's heal (until a later
+    # partition overwrites them): the recovery probe keeps measuring the
+    # gap between the FORMER components after the edges heal, so
+    # ``chaos_component_gap`` visibly decays to ~0 instead of snapping to
+    # a structural zero the moment the window closes. Edge masks below
+    # still heal exactly at ``stop``.
+    comp = np.zeros((rows, n), dtype=np.int32)
+    for p in cfg.partitions:
+        ids = np.full(n, len(p.components), dtype=np.int32)  # implicit grp
+        for g, grp in enumerate(p.components):
+            ids[list(grp)] = g
+        comp[p.start:] = ids
+
+    mask_idx = np.zeros(rows, dtype=np.int32)
+    edge_masks: Any = ()
+    csr_masks: Any = ()
+    slot_masks: Any = ()
+
+    if cfg.has_edge_faults():
+        pi, pj = _undirected_pairs(topology)
+        n_pairs = len(pi)
+        pair_alive_rows = [np.ones(n_pairs, dtype=bool)]  # mask 0: baseline
+        seen = {pair_alive_rows[0].tobytes(): 0}
+        churn = cfg.churn
+        churn_cache: dict = {}
+
+        def churn_alive(epoch: int) -> np.ndarray:
+            if epoch not in churn_cache:
+                rng = np.random.default_rng((int(churn.seed), int(epoch)))
+                churn_cache[epoch] = rng.random(n_pairs) < churn.keep_frac
+            return churn_cache[epoch]
+
+        part_active = np.zeros(T, dtype=bool)
+        for p in cfg.partitions:
+            part_active[p.start:min(p.stop, T)] = True
+        for r in range(T):
+            churn_on = (churn is not None
+                        and churn.start <= r < churn.stop)
+            if not (part_active[r] or churn_on):
+                continue
+            alive = np.ones(n_pairs, dtype=bool)
+            if part_active[r]:
+                alive &= comp[r, pi] == comp[r, pj]
+            if churn_on:
+                alive &= churn_alive((r - churn.start) // churn.period)
+            key = alive.tobytes()
+            if key not in seen:
+                seen[key] = len(pair_alive_rows)
+                pair_alive_rows.append(alive)
+            mask_idx[r] = seen[key]
+
+        pair_alive = np.stack(pair_alive_rows)  # [M, n_pairs]
+        m_count = pair_alive.shape[0]
+        if isinstance(topology, SparseTopology):
+            # Directed CSR edge order (rows ascending, neighbor-sorted):
+            # map each directed edge to its unordered pair's draw.
+            src = np.repeat(np.arange(n, dtype=np.int64),
+                            np.asarray(topology.degrees, dtype=np.int64))
+            dst = topology.indices.astype(np.int64)
+            lo, hi = np.minimum(src, dst), np.maximum(src, dst)
+            pair_key = pi * n + pj
+            order = np.argsort(pair_key)
+            pos = np.searchsorted(pair_key[order], lo * n + hi)
+            pair_of_edge = order[pos]
+            csr = pair_alive[:, pair_of_edge]  # [M, 2E]
+            csr_masks = csr
+            # Padded slot form for alive-neighbor sampling: slot s of row
+            # i is edge (indptr[i] + s).
+            degrees = np.asarray(topology.degrees, dtype=np.int64)
+            max_deg = max(int(degrees.max()) if n else 0, 1)
+            slot = np.zeros((m_count, n, max_deg), dtype=bool)
+            rows_e = src
+            pos_e = np.arange(len(src)) - topology.indptr[rows_e]
+            slot[:, rows_e, pos_e] = csr
+            slot_masks = slot
+        else:
+            dense = np.ones((m_count, n, n), dtype=bool)
+            dense[:, pi, pj] = pair_alive
+            dense[:, pj, pi] = pair_alive
+            edge_masks = dense
+
+    return FaultSchedule(
+        forced_offline=forced,
+        drop_prob=drop,
+        delay_scale=scale,
+        mask_idx=mask_idx,
+        component_id=comp,
+        edge_masks=edge_masks,
+        csr_masks=csr_masks,
+        slot_masks=slot_masks,
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-graph chaos stats (recovery evidence)
+# ---------------------------------------------------------------------------
+
+# Per-round chaos stat keys the engines emit when chaos + consensus probes
+# are on (report registry fields, JSONL ``chaos`` row, ``update_chaos``
+# observer event). ``failed_chaos`` — the fourth failure cause — travels
+# with the cause breakdown instead.
+CHAOS_PROBE_KEYS = ("chaos_component_gap", "chaos_within_mean",
+                    "chaos_active_components")
+
+
+def chaos_round_stats(params: Any, component_id: jax.Array,
+                      n_components: int) -> dict:
+    """One round's partition-recovery vitals over stacked params (leaves
+    ``[N, ...]``), grouped by the round's SCHEDULED component ids:
+
+    - ``chaos_component_gap``: max pairwise L2 distance between the mean
+      parameter vectors of the non-empty components (0 with a single
+      component) — the quantity that must OPEN while a partition holds
+      and RECONVERGE to ~0 after the heal;
+    - ``chaos_within_mean``: mean over nodes of the L2 distance to their
+      own component's mean (per-component mixing health);
+    - ``chaos_active_components``: how many scheduled components hold at
+      least one node this round.
+
+    ``n_components`` is static (``ChaosConfig.max_components()``), so
+    the segment reductions have fixed shapes under jit.
+    """
+    leaves = jax.tree_util.tree_leaves(params)
+    n = leaves[0].shape[0]
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).reshape(n, -1) for l in leaves], axis=1)
+    comp = component_id.astype(jnp.int32)
+    ones = jnp.ones((n,), jnp.float32)
+    counts = jax.ops.segment_sum(ones, comp, num_segments=n_components)
+    sums = jax.ops.segment_sum(flat, comp, num_segments=n_components)
+    means = sums / jnp.maximum(counts, 1.0)[:, None]
+    own = means[comp]  # [N, P]
+    within = jnp.sqrt(((flat - own) ** 2).sum(axis=1)).mean()
+    present = counts > 0
+    d2 = ((means[:, None, :] - means[None, :, :]) ** 2).sum(-1)
+    both = present[:, None] & present[None, :]
+    gap = jnp.sqrt(jnp.max(jnp.where(both, d2, 0.0)))
+    return {
+        "chaos_component_gap": gap.astype(jnp.float32),
+        "chaos_within_mean": within.astype(jnp.float32),
+        "chaos_active_components": present.sum().astype(jnp.int32),
+    }
+
+
+def chaos_event_row(vals: dict) -> Optional[dict]:
+    """The per-round ``update_chaos`` observer payload (JSON-able
+    scalars) from one round's chaos values; None when ``vals`` carries
+    none."""
+    if not vals:
+        return None
+    row: dict = {}
+    if "chaos_component_gap" in vals:
+        row["component_gap"] = float(vals["chaos_component_gap"])
+        row["within_mean"] = float(vals["chaos_within_mean"])
+        row["active_components"] = int(vals["chaos_active_components"])
+    if "failed_chaos" in vals:
+        row["failed_chaos"] = int(vals["failed_chaos"])
+    return row or None
+
+
+# ---------------------------------------------------------------------------
+# Host-side recovery analysis
+# ---------------------------------------------------------------------------
+
+def rounds_to_reconverge(gap: np.ndarray, heal_round: int,
+                         tol: Optional[float] = None) -> Optional[int]:
+    """How many rounds after ``heal_round`` the per-round ``gap`` series
+    (e.g. a report's ``chaos_component_gap``, index = round) took to
+    close. ``tol`` defaults to 5% of the gap's peak over the pre-heal
+    window (floor 1e-6). Returns the 1-based round count after the heal
+    (0 = already closed at the heal round), or None if the series never
+    closes within the report."""
+    gap = np.asarray(gap, dtype=np.float64)
+    heal = int(heal_round)
+    if tol is None:
+        peak = float(np.nanmax(gap[:heal])) if heal > 0 else 0.0
+        tol = max(0.05 * peak, 1e-6)
+    for i in range(heal, len(gap)):
+        if np.isfinite(gap[i]) and gap[i] <= tol:
+            return i - heal
+    return None

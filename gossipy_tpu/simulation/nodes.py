@@ -257,6 +257,10 @@ class CacheNeighGossipSimulator(GossipSimulator):
         """At timeout: pop a random occupied cache slot and merge-update with
         it before snapshotting/sending (node.py:446-452)."""
         fires, _ = self._fire_mask(state, r)
+        if self.chaos is not None:
+            # A forced-offline node doesn't wake to merge its cache
+            # either (matches the send gate in _send_phase).
+            fires = fires & ~self._chaos_forced_offline(r)
         valid = state.aux["cache_valid"]  # [N, S]
         any_cached = valid.any(axis=1)
         logits = jnp.where(valid, 0.0, -jnp.inf)

@@ -44,8 +44,10 @@ import numpy as np
 
 # 1: sent/failed/size/evals; 2: + cause breakdown & mailbox/compact diag;
 # 3: + gossip-dynamics probe arrays (probe_*) and the static probe context;
-# 4: + numerics-sentinel health arrays (health_*; telemetry.health).
-REPORT_SCHEMA = 4
+# 4: + numerics-sentinel health arrays (health_*; telemetry.health);
+# 5: + scheduled-fault chaos arrays (chaos_*; simulation.faults) and the
+#    optional "chaos" key in failed_per_cause.
+REPORT_SCHEMA = 5
 
 # Optional per-round arrays (attribute name == JSON key), concatenated
 # along axis 0 by :meth:`SimulationReport.concatenate` (surviving only
@@ -78,6 +80,11 @@ PER_ROUND_FIELDS = (
     "health_delta_hwm",              # [R] f32: running high-water mark
     "health_mailbox_hwm_run",        # [R] i32: run-level saturation watermark
     "health_trip",                   # [R] i32: any sentinel tripped
+    "chaos_component_gap",           # [R] f32: max distance between
+                                     # scheduled-component mean params
+    "chaos_within_mean",             # [R] f32: mean distance of nodes from
+                                     # their own component's mean
+    "chaos_active_components",       # [R] i32: non-empty components
     "wall_clock_seconds_per_round",  # [R] f64 (live runs only)
 )
 
@@ -98,6 +105,7 @@ _INT_FIELDS = frozenset({
     "health_nonfinite_metrics", "health_first_bad_slot",
     "health_mix_nonfinite", "health_diverged_per_node",
     "health_mailbox_hwm_run", "health_trip",
+    "chaos_active_components",
 })
 
 

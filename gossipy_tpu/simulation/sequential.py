@@ -65,7 +65,16 @@ from ..telemetry.probes import (
 )
 from .engine import PROTO_TO_MSG
 from .events import SimulationEventSender
+from .faults import (
+    ChaosConfig,
+    build_fault_schedule,
+    chaos_round_stats,
+)
 from .report import SimulationReport
+
+# Node-behavior variants the sequential engine can replicate eagerly for
+# parity studies against the jitted subclasses (simulation.nodes).
+SEQ_VARIANTS = ("passthrough", "cache_neigh")
 
 
 @dataclass
@@ -109,6 +118,26 @@ class SequentialGossipSimulator(SimulationEventSender):
     ``utility_fun(receiver_model: ModelState, sender_snapshot: PeerModel)
     -> float`` is the per-message utility (constant 1 default, the shipped
     experiment's choice, reference main_hegedus_2021.py:59).
+
+    ``variant`` replicates a node-behavior subclass eagerly for parity
+    studies (the ROADMAP fidelity corner): ``"passthrough"`` (Giaretta
+    2019 degree-biased accept-or-adopt,
+    :class:`~gossipy_tpu.simulation.PassThroughGossipSimulator`) or
+    ``"cache_neigh"`` (one parked model slot per neighbor, popped and
+    merged at send time,
+    :class:`~gossipy_tpu.simulation.CacheNeighGossipSimulator`). Variant
+    randomness (accept draws, cache pops) uses a DEDICATED host RNG so a
+    variant run with accept probability pinned at 1 reproduces the
+    vanilla trajectory bit-for-bit. Mutually exclusive with
+    ``token_account``.
+
+    ``chaos`` applies the same scheduled fault plane as the jitted
+    engines (:mod:`.faults`): forced-outage windows (no sends, no
+    receives; failures attributed to the ``"chaos"`` cause), per-round
+    partition/churn edge masks constraining peer sampling, and
+    drop/delay spikes — evaluated eagerly from the same compiled
+    :class:`~gossipy_tpu.simulation.faults.FaultSchedule` tables, so
+    jitted-vs-sequential chaos parity is testable per fault type.
     """
 
     def __init__(self,
@@ -125,8 +154,19 @@ class SequentialGossipSimulator(SimulationEventSender):
                  token_account: Optional[TokenAccount] = None,
                  utility_fun: Optional[Callable] = None,
                  probes=None,
-                 sentinels=None):
+                 sentinels=None,
+                 variant: Optional[str] = None,
+                 chaos=None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
+        if variant is not None and variant not in SEQ_VARIANTS:
+            raise ValueError(f"unknown sequential variant {variant!r}; "
+                             f"options: {SEQ_VARIANTS}")
+        if variant is not None and token_account is not None:
+            raise ValueError("variant= and token_account= are mutually "
+                             "exclusive (the jitted engines compose them "
+                             "via subclassing; the eager parity modes do "
+                             "not)")
+        self.variant = variant
         self.handler = handler
         self.topology = topology
         self.n_nodes = topology.num_nodes
@@ -176,7 +216,8 @@ class SequentialGossipSimulator(SimulationEventSender):
         self.probes: Optional[ProbeConfig] = ProbeConfig.coerce(probes)
         self._probe_delta_ok = (
             self.probes is not None and self.probes.mixing
-            and handler.mode == CreateModelMode.MERGE_UPDATE)
+            and handler.mode == CreateModelMode.MERGE_UPDATE
+            and variant is None)
         if self._probe_delta_ok:
             self._jit_merge = jax.jit(handler.merge)
         if self.probes is not None:
@@ -191,6 +232,23 @@ class SequentialGossipSimulator(SimulationEventSender):
         # Cross-run divergence-EMA state, same contract as the jitted
         # engine: persists across start() calls, reset by init_nodes.
         self._health_carry: Optional[HealthCarry] = None
+        # Scheduled fault injection: the SAME host-compiled schedule
+        # tables the jitted engines index in-graph, consumed eagerly
+        # here (numpy; rounds clamp to the trailing baseline row).
+        self.chaos: Optional[ChaosConfig] = ChaosConfig.coerce(chaos)
+        self._chaos_sched = None
+        self._chaos_ncomp = 1
+        self._chaos_nbr_cache: dict = {}
+        if self.chaos is not None:
+            self._chaos_sched = build_fault_schedule(
+                self.chaos, topology, self.drop_prob)
+            self._chaos_ncomp = self.chaos.max_components()
+            self._jit_chaos_stats = jax.jit(
+                lambda p, c: chaos_round_stats(p, c, self._chaos_ncomp))
+            if self.chaos.has_edge_faults() and isinstance(
+                    self._chaos_sched.slot_masks, np.ndarray):
+                from .nodes import build_neighbor_table
+                self._chaos_nbr_table = build_neighbor_table(topology)
 
         def eval_global(stacked, xe, ye, me):
             return jax.vmap(lambda m: handler.evaluate(m, (xe, ye, me)))(
@@ -242,12 +300,57 @@ class SequentialGossipSimulator(SimulationEventSender):
                  * rng.standard_normal(n)).astype(np.int64), 1)
         balance = (np.asarray(self.account.init_balance(n)).copy()
                    if self.account is not None else None)
+        # cache_neigh variant: one parked PeerModel per (receiver, sender),
+        # latest wins — the eager counterpart of the jitted per-neighbor
+        # slot cache. Host-side (reset with the population).
+        self._cn_cache = [dict() for _ in range(n)]
         return SeqState(models=models, phase=phase, balance=balance)
 
     def _fires(self, state: SeqState, i: int, t: int) -> bool:
         if self.sync:
             return t % self.delta == int(state.phase[i])
         return t % int(state.phase[i]) == 0
+
+    # -- chaos schedule reads (eager counterparts of the engine's traced
+    # -- gathers; rounds clamp to the trailing baseline row) ----------------
+
+    def _chaos_row(self, r: int) -> int:
+        return min(int(r), self._chaos_sched.rows - 1)
+
+    def _forced_at(self, r: int):
+        return self._chaos_sched.forced_offline[self._chaos_row(r)]
+
+    def _drop_prob_at(self, r: int) -> float:
+        if self.chaos is None:
+            return self.drop_prob
+        return float(self._chaos_sched.drop_prob[self._chaos_row(r)])
+
+    def _delay_scale_at(self, r: int) -> float:
+        if self.chaos is None:
+            return 1.0
+        return float(self._chaos_sched.delay_scale[self._chaos_row(r)])
+
+    def _alive_nbrs(self, i: int, r: int):
+        """Node ``i``'s out-neighbors alive at round ``r`` (partition/
+        churn edge masks applied; the static list when no edge fault is
+        scheduled). Cached per (mask, node)."""
+        if self.chaos is None or not self.chaos.has_edge_faults():
+            return self._nbrs[i]
+        m = int(self._chaos_sched.mask_idx[self._chaos_row(r)])
+        if m == 0:
+            return self._nbrs[i]
+        key = (m, i)
+        if key not in self._chaos_nbr_cache:
+            sched = self._chaos_sched
+            if isinstance(sched.edge_masks, np.ndarray):  # dense topology
+                row = np.asarray(self.topology.adjacency[i]) \
+                    & sched.edge_masks[m, i]
+                self._chaos_nbr_cache[key] = np.where(row)[0]
+            else:
+                nbr = self._chaos_nbr_table[i]
+                alive = sched.slot_masks[m, i] & (nbr >= 0)
+                self._chaos_nbr_cache[key] = nbr[alive]
+        return self._chaos_nbr_cache[key]
 
     def _metric_keys(self) -> list:
         if self._metric_names is None:
@@ -266,11 +369,20 @@ class SequentialGossipSimulator(SimulationEventSender):
               key: Optional[jax.Array] = None):
         """Run ``n_rounds * delta`` ticks; returns (state, report)."""
         key = jax.random.PRNGKey(42) if key is None else key
+        # The tick loop is RELATIVE to this start() call; the chaos
+        # schedule (like the jitted engine's) keys on ABSOLUTE rounds so
+        # chunked continuation hits the same fault windows.
+        round0 = int(state.round)
         # Split, don't fold: the host-scheduling seed must live in a key
         # space disjoint from next_key()'s fold_in(key, counter) draws.
         k_host, key = jax.random.split(key)
         rng = np.random.default_rng(
             int(jax.random.randint(k_host, (), 0, 2 ** 31 - 1)))
+        # Variant randomness (accept draws, cache pops) lives on its OWN
+        # stream: a variant whose draws are all no-ops (accept prob 1)
+        # then reproduces the vanilla trajectory bit-for-bit.
+        var_rng = np.random.default_rng(int(jax.random.randint(
+            jax.random.fold_in(k_host, 7), (), 0, 2 ** 31 - 1)))
         names = self._metric_keys()
         n, delta = self.n_nodes, self.delta
         msg_q: dict = {}   # tick -> [_Pending]; mutated mid-drain by
@@ -287,6 +399,11 @@ class SequentialGossipSimulator(SimulationEventSender):
         offline_pr = np.zeros(n_rounds, np.int64)
         overflow_pr = np.zeros(n_rounds, np.int64)
         size_pr = np.zeros(n_rounds, np.int64)
+        if self.chaos is not None:
+            chaos_pr = np.zeros(n_rounds, np.int64)
+            chaos_gap_pr = np.zeros(n_rounds, np.float64)
+            chaos_within_pr = np.zeros(n_rounds, np.float64)
+            chaos_active_pr = np.zeros(n_rounds, np.int64)
         local_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
         global_rows = np.full((n_rounds, len(names)), np.nan, np.float32)
         # Per-round probe accumulators (same definitions as the jitted
@@ -346,13 +463,14 @@ class SequentialGossipSimulator(SimulationEventSender):
                 sent_pr[r] += 1
                 size_pr[r] += rec.size
                 self._fire_message(False, rec)
-            if rng.random() < self.drop_prob:
+            if rng.random() < self._drop_prob_at(round0 + rec.round):
                 failed_pr[r] += 1
                 drop_pr[r] += 1
                 self._fire_message(True, rec)
                 return
             d = int(np.asarray(self.delay.sample(next_key(), (1,),
                                                  rec.size))[0])
+            d = int(d * self._delay_scale_at(round0 + rec.round))  # spike
             q = rep_q if is_reply else msg_q
             q.setdefault(t + d, []).append(_Pending(rec, payload, is_reply))
 
@@ -361,7 +479,16 @@ class SequentialGossipSimulator(SimulationEventSender):
         send_size = 1 if is_pull else self._size  # PULL requests carry no model
 
         def send_from(i: int, t: int, r: int):
-            nbrs = self._nbrs[i]
+            if self.variant == "cache_neigh" and self._cn_cache[i]:
+                # Pop a random parked neighbor model and merge-update
+                # before sending (the jitted _pre_send semantics).
+                senders = list(self._cn_cache[i])
+                pick = senders[var_rng.integers(len(senders))]
+                pm = self._cn_cache[i].pop(pick)
+                state.models[i] = self._jit_call(
+                    state.models[i], pm, self._node_data(i), next_key(),
+                    None)
+            nbrs = self._alive_nbrs(i, round0 + r)
             if len(nbrs) == 0:
                 return  # isolated node: skip (reference `break` aborts the
                         # whole sweep, simul.py:398-399 — a bug)
@@ -373,6 +500,13 @@ class SequentialGossipSimulator(SimulationEventSender):
 
         def receive(p: _Pending, t: int, r: int, is_online) -> None:
             i = p.rec.receiver
+            if self.chaos is not None and self._forced_at(round0 + r)[i]:
+                # Scheduled outage: the receiver is forced offline —
+                # the fourth ("chaos") failure cause, like the engine.
+                failed_pr[r] += 1
+                chaos_pr[r] += 1
+                self._fire_message(True, p.rec)
+                return
             if not is_online[i]:
                 failed_pr[r] += 1
                 offline_pr[r] += 1
@@ -409,6 +543,25 @@ class SequentialGossipSimulator(SimulationEventSender):
                     train_sq_pr[r] += float(self._jit_sqdist(
                         new.params, merged.params))
                     state.models[i] = new
+                elif self.variant == "passthrough":
+                    # Accept (merge+update) with p = min(1, deg_s/deg_r),
+                    # else adopt the received model as-is (PASS) — the
+                    # jitted PassThrough receive, degrees from the STATIC
+                    # topology like the jitted variant's.
+                    deg_r = max(int(self.topology.degrees[i]), 1)
+                    deg_s = int(self.topology.degrees[p.rec.sender])
+                    if var_rng.random() < min(1.0, deg_s / deg_r):
+                        state.models[i] = self._jit_call(
+                            state.models[i], p.payload, self._node_data(i),
+                            next_key(), None)
+                    else:
+                        state.models[i] = ModelState(
+                            p.payload.params, state.models[i].opt_state,
+                            p.payload.n_updates)
+                elif self.variant == "cache_neigh":
+                    # Park instead of merging (latest wins per sender);
+                    # popped + merged at the receiver's next send.
+                    self._cn_cache[i][p.rec.sender] = p.payload
                 else:
                     state.models[i] = self._jit_call(
                         state.models[i], p.payload, self._node_data(i),
@@ -449,6 +602,10 @@ class SequentialGossipSimulator(SimulationEventSender):
             for i in order:
                 if not self._fires(state, int(i), t):
                     continue
+                if self.chaos is not None \
+                        and self._forced_at(round0 + r)[int(i)]:
+                    continue  # scheduled outage: no sends either
+
                 if self.account is not None:
                     p = float(np.asarray(self.account.proactive(
                         jnp.asarray([state.balance[int(i)]])))[0])
@@ -486,6 +643,17 @@ class SequentialGossipSimulator(SimulationEventSender):
                     cons_mean[r] = float(cm)
                     cons_max[r] = float(cx)
                     cons_layers[r] = np.asarray(cl)
+                if self.chaos is not None and probes is not None \
+                        and probes.consensus:
+                    sp = jax.tree.map(lambda *ls: jnp.stack(ls),
+                                      *[m.params for m in state.models])
+                    comp = jnp.asarray(self._chaos_sched.component_id[
+                        self._chaos_row(round0 + r)])
+                    cs = self._jit_chaos_stats(sp, comp)
+                    chaos_gap_pr[r] = float(cs["chaos_component_gap"])
+                    chaos_within_pr[r] = float(cs["chaos_within_mean"])
+                    chaos_active_pr[r] = int(
+                        cs["chaos_active_components"])
                 if sentinels is not None:
                     # Same vitals definition as the jitted engine's scan
                     # body (health_round_stats is the shared pure math).
@@ -535,6 +703,11 @@ class SequentialGossipSimulator(SimulationEventSender):
                     extras["probe_merge_delta"] = nan_pr
                     extras["probe_train_delta"] = nan_pr.copy()
                 extras["probe_expected_fanin"] = self._probe_expected_fanin()
+        if self.chaos is not None and probes is not None \
+                and probes.consensus:
+            extras["chaos_component_gap"] = chaos_gap_pr
+            extras["chaos_within_mean"] = chaos_within_pr
+            extras["chaos_active_components"] = chaos_active_pr
         if sentinels is not None:
             if sentinels.nonfinite:
                 extras["health_nonfinite_params"] = h_nf_params
@@ -548,18 +721,23 @@ class SequentialGossipSimulator(SimulationEventSender):
             extras["health_delta_norm"] = h_delta_norm
             extras["health_delta_hwm"] = h_delta_hwm
             extras["health_trip"] = h_trip
+        causes = {"drop": drop_pr, "offline": offline_pr,
+                  "overflow": overflow_pr}
+        if self.chaos is not None:
+            causes["chaos"] = chaos_pr
         report = SimulationReport(
             metric_names=names,
             local_evals=local_rows if self.has_local_test else None,
             global_evals=global_rows if self.has_global_eval else None,
             sent=sent_pr, failed=failed_pr, total_size=int(size_pr.sum()),
-            failed_by_cause={"drop": drop_pr, "offline": offline_pr,
-                             "overflow": overflow_pr},
+            failed_by_cause=causes,
             **extras)
         self.replay_events(state.round - n_rounds, {
             "sent": sent_pr, "failed": failed_pr,
             "failed_drop": drop_pr, "failed_offline": offline_pr,
             "failed_overflow": overflow_pr, "size": size_pr,
+            **({"failed_chaos": chaos_pr} if self.chaos is not None
+               else {}),
             "local": local_rows, "global": global_rows,
             # Per-round probe/health arrays ride the same replay so
             # receivers get update_probes/update_health from this engine

@@ -127,14 +127,20 @@ class TokenizedGossipSimulator(GossipSimulator):
         msg_type = PROTO_TO_MSG[self.protocol]
         for j in range(self.max_reactions):
             fire = pending > j
+            if self.chaos is not None:
+                fire = fire & ~self._chaos_forced_offline(r)
             kj = self._round_key(base_key, r, _K_REACT_PEER + 10 * j)
-            peers = self.topology.sample_peers(kj)
+            if self.chaos is not None and self._chaos_edge_form is not None:
+                peers = self._chaos_masked_peers(kj, r)
+            else:
+                peers = self.topology.sample_peers(kj)
             active = fire & (peers >= 0)
             dropped = jax.random.bernoulli(
                 self._round_key(base_key, r, _K_REACT_DROP + 10 * j),
-                self.drop_prob, (n,))
-            delays = self.delay.sample(
-                self._round_key(base_key, r, _K_REACT_DELAY + 10 * j), (n,), size)
+                self._chaos_drop_prob(r), (n,))
+            delays = self._chaos_scale_delays(self.delay.sample(
+                self._round_key(base_key, r, _K_REACT_DELAY + 10 * j),
+                (n,), size), r)
             # Reaction messages are emitted mid-round; same-round delivery is
             # not possible once the mailbox cell was drained, so the earliest
             # delivery is next round (documented divergence).
@@ -271,6 +277,10 @@ class All2AllGossipSimulator(GossipSimulator):
                 self._nbr_tab = jnp.asarray(nbr)
                 self._w_tab = jnp.asarray(wt)
                 self._slot_valid = jnp.asarray(slot_valid)
+                # CSR-edge -> padded-slot scatter coordinates, used to
+                # land the chaos per-edge alive mask in slot layout.
+                self._pad_rows = jnp.asarray(rows.astype(np.int32))
+                self._pad_pos = jnp.asarray(pos.astype(np.int32))
         else:
             # Fail at construction, not at the first jitted round's
             # adjacency_dev access deep inside _round (must survive -O).
@@ -321,6 +331,7 @@ class All2AllGossipSimulator(GossipSimulator):
         mix_bad = None
         acc_count = None
         merge_sq = train_sq = jnp.float32(0)
+        n_chaos = jnp.int32(0)
         with jax.named_scope(PHASE_SEND):
             state = self._snapshot(state, r)
             n = self.n_nodes
@@ -329,14 +340,35 @@ class All2AllGossipSimulator(GossipSimulator):
             online = jax.random.bernoulli(
                 self._round_key(base_key, r, _K_A2A_ONLINE),
                 self.online_prob, (n,))
+            if self.chaos is not None:
+                # Scheduled outages silence a node on BOTH sides of the
+                # broadcast (it neither fires nor receives); partitions/
+                # churn mask the mixed edge set per round below. Drop
+                # spikes override the per-edge drop rate.
+                forced = self._chaos_forced_offline(r)
+                fires = fires & ~forced
+                online = online & ~forced
+        chaos_edges = (self.chaos is not None
+                       and self._chaos_edge_form is not None)
+        if chaos_edges:
+            sched = self.chaos_schedule
+            chaos_m = sched.mask_idx[self._chaos_t(r)]
         if self.sparse_mix and self._sparse_padded:
             # Padded [N, max_deg] formulation (near-regular graphs): the
             # merge is a gather + einsum — regular shapes, no scatter; the
             # TPU-native form of the sparse mix.
             nbr, wt, slot = self._nbr_tab, self._w_tab, self._slot_valid
+            if chaos_edges:
+                # Per-round alive-edge mask scattered from the CSR-order
+                # per-edge mask into the padded slot layout (one O(E)
+                # scatter per round; masked edges do not exist — their
+                # sends are neither counted nor failed).
+                pad = jnp.zeros(slot.shape, bool).at[
+                    self._pad_rows, self._pad_pos].set(sched.csr_masks[chaos_m])
+                slot = slot & pad
             drop = jax.random.bernoulli(
-                self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob,
-                wt.shape)
+                self._round_key(base_key, r, _K_A2A_DROP),
+                self._chaos_drop_prob(r), wt.shape)
             sent = fires[nbr] & slot
             live = sent & ~drop & online[:, None]
             w = wt * live
@@ -367,9 +399,13 @@ class All2AllGossipSimulator(GossipSimulator):
             n_sent = sent.sum()
             # Cause attribution matches the bulk engine: a dropped message
             # never reaches its receiver, so drop is charged first and
-            # offline only on surviving edges.
+            # offline only on surviving edges (forced-offline receivers
+            # get the scheduled-fault "chaos" cause).
             n_drop = (sent & drop).sum()
             n_offline = (sent & ~drop & ~online[:, None]).sum()
+            if self.chaos is not None:
+                n_chaos = (sent & ~drop & forced[:, None]).sum()
+                n_offline = n_offline - n_chaos
             received_any = (live & (wt > 0)).any(axis=1)
             if probe_mix:
                 acc_count = (live & (wt > 0)).sum(axis=1).astype(jnp.int32)
@@ -385,9 +421,13 @@ class All2AllGossipSimulator(GossipSimulator):
             mix = self.mixing
             n_edges = mix.rows.shape[0]
             drop_e = jax.random.bernoulli(
-                self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob,
-                (n_edges,))
+                self._round_key(base_key, r, _K_A2A_DROP),
+                self._chaos_drop_prob(r), (n_edges,))
             sent_e = fires[mix.senders]
+            if chaos_edges:
+                # O(E) per-edge alive mask, gathered in CSR order (the
+                # SparseMixing edge layout).
+                sent_e = sent_e & sched.csr_masks[chaos_m]
             live_e = sent_e & ~drop_e & online[mix.rows]
             w_e = mix.edge_w * live_e
             # mix.rows is non-decreasing by CSR construction: the sorted
@@ -418,6 +458,9 @@ class All2AllGossipSimulator(GossipSimulator):
             n_sent = sent_e.sum()
             n_drop = (sent_e & drop_e).sum()
             n_offline = (sent_e & ~drop_e & ~online[mix.rows]).sum()
+            if self.chaos is not None:
+                n_chaos = (sent_e & ~drop_e & forced[mix.rows]).sum()
+                n_offline = n_offline - n_chaos
             received_any = jax.ops.segment_max(
                 (live_e & (mix.edge_w > 0)).astype(jnp.int32), mix.rows, n,
                 indices_are_sorted=True) > 0
@@ -434,9 +477,11 @@ class All2AllGossipSimulator(GossipSimulator):
             # Per-edge liveness: sender fired, message not dropped, receiver
             # online.
             drop = jax.random.bernoulli(
-                self._round_key(base_key, r, _K_A2A_DROP), self.drop_prob,
-                (n, n))
+                self._round_key(base_key, r, _K_A2A_DROP),
+                self._chaos_drop_prob(r), (n, n))
             adj = self.topology.adjacency_dev
+            if chaos_edges:
+                adj = adj & sched.edge_masks[chaos_m]
             live = adj & fires[None, :] & ~drop & online[:, None]  # [recv, sender]
 
             w = self.mixing * live
@@ -450,6 +495,9 @@ class All2AllGossipSimulator(GossipSimulator):
             n_sent = sent_mask.sum()
             n_drop = (sent_mask & drop).sum()
             n_offline = (sent_mask & ~drop & ~online[:, None]).sum()
+            if self.chaos is not None:
+                n_chaos = (sent_mask & ~drop & forced[:, None]).sum()
+                n_offline = n_offline - n_chaos
             received_any = (live & (self.mixing > 0)).any(axis=1)
             if probe_mix:
                 acc_count = (live & (self.mixing > 0)).sum(axis=1) \
@@ -546,7 +594,9 @@ class All2AllGossipSimulator(GossipSimulator):
         state = state._replace(round=r + 1)
         fails = FailureCounts(drop=n_drop.astype(jnp.int32),
                               offline=n_offline.astype(jnp.int32),
-                              overflow=jnp.int32(0))
+                              overflow=jnp.int32(0),
+                              chaos=(n_chaos.astype(jnp.int32)
+                                     if self.chaos is not None else ()))
         stats = {
             "sent": n_sent,
             "failed": fails.total(),
@@ -563,6 +613,10 @@ class All2AllGossipSimulator(GossipSimulator):
             "local": local,
             "global": glob,
         }
+        if self.chaos is not None:
+            stats["failed_chaos"] = fails.chaos
+            if self._chaos_probes_on():
+                stats.update(self._chaos_stats(state, r))
         if self.probes is not None:
             cfg = self.probes
             if cfg.consensus:

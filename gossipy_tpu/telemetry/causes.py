@@ -17,14 +17,18 @@ messages that made it into a slot), so
 
 from __future__ import annotations
 
-from typing import NamedTuple, Union
+from typing import Any, NamedTuple, Union
 
 import jax
 import jax.numpy as jnp
 
 # Canonical cause ordering — report dicts, JSONL rows and event payloads
-# all key on these names.
+# all key on these names. The scheduled-fault ``"chaos"`` cause
+# (simulation.faults) is ADDITIVE on top: it appears in cause
+# breakdowns only when a run was configured with ``chaos=``, so
+# chaos-free reports keep exactly these three keys.
 FAILURE_CAUSES = ("drop", "offline", "overflow")
+CHAOS_CAUSE = "chaos"
 
 
 class FailureCounts(NamedTuple):
@@ -37,31 +41,57 @@ class FailureCounts(NamedTuple):
     - ``overflow``: no free slot in the receiver's per-round mailbox cell
       (an engine-only cause: the reference's Python queues are unbounded,
       and so are the sequential engine's).
+    - ``chaos``: reached a mailbox slot but the receiver was FORCED
+      offline by a scheduled fault window (simulation.faults). The
+      default ``()`` is an EMPTY pytree — chaos-free programs carry no
+      fourth counter leaf at all, so their scan carries and HLO are
+      byte-identical to builds predating the chaos layer. Engines with
+      chaos on seed their accumulators via
+      ``FailureCounts.zeros(chaos_on=True)``.
     """
 
     drop: Union[jax.Array, int]
     offline: Union[jax.Array, int]
     overflow: Union[jax.Array, int]
+    chaos: Any = ()
 
     @classmethod
-    def zeros(cls) -> "FailureCounts":
-        return cls(jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    def zeros(cls, chaos_on: bool = False) -> "FailureCounts":
+        return cls(jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                   jnp.int32(0) if chaos_on else ())
 
     # NamedTuple's inherited ``+`` is tuple concatenation — override with
     # the elementwise sum so accumulator code reads naturally.
     def __add__(self, other: "FailureCounts") -> "FailureCounts":  # type: ignore[override]
+        a, b = self.chaos, other.chaos
+        if isinstance(a, tuple):
+            chaos = b
+        elif isinstance(b, tuple):
+            chaos = a
+        else:
+            chaos = a + b
         return FailureCounts(self.drop + other.drop,
                              self.offline + other.offline,
-                             self.overflow + other.overflow)
+                             self.overflow + other.overflow,
+                             chaos)
 
     def __radd__(self, other):
         if other == 0:  # support sum([...])
             return self
         return self.__add__(other)
 
+    def add_chaos(self, n) -> "FailureCounts":
+        """Accumulate ``n`` chaos-caused failures (activates the fourth
+        counter if this instance still carries the empty default)."""
+        c = n if isinstance(self.chaos, tuple) else self.chaos + n
+        return self._replace(chaos=c)
+
     def total(self):
         """The legacy ``failed`` counter: the exact sum of the causes."""
-        return self.drop + self.offline + self.overflow
+        t = self.drop + self.offline + self.overflow
+        if isinstance(self.chaos, tuple):
+            return t
+        return t + self.chaos
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in FAILURE_CAUSES}

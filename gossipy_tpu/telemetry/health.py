@@ -379,13 +379,30 @@ class FlightRecorder:
                               "kind": kind,
                               "round": int(chunk_start_round)})
 
+        detail = dict(detail or {})
+        chaos_cfg = getattr(sim, "chaos", None)
+        if chaos_cfg is not None and "chaos_windows" not in detail:
+            # A chaos-scenario bundle names the fault windows active at
+            # the tripped round AND at the checkpoint round the replay
+            # restores from — a heal-induced trip (the common partition
+            # failure mode) fires just AFTER its window closes, so the
+            # trip round alone can read as fault-free.
+            at = (first_bad_round if first_bad_round is not None
+                  else chunk_start_round)
+            try:
+                detail["chaos_windows"] = chaos_cfg.active_at(at)
+                detail["chaos_windows_at_checkpoint"] = \
+                    chaos_cfg.active_at(chunk_start_round)
+                detail["chaos_horizon"] = int(chaos_cfg.horizon)
+            except Exception:  # verdict context is best-effort
+                pass
         verdict = {
             "bundle_version": BUNDLE_VERSION,
             "kind": kind,
             "chunk_start_round": int(chunk_start_round),
             "first_bad_round": (int(first_bad_round)
                                 if first_bad_round is not None else None),
-            "detail": detail or {},
+            "detail": detail,
         }
         with open(os.path.join(path, "verdict.json"), "w") as fh:
             json.dump(verdict, fh, indent=2)

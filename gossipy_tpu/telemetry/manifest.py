@@ -116,6 +116,13 @@ def _config_snapshot(sim: Any) -> dict:
         sentinels = sim.sentinels
         snap["sentinels"] = (sentinels.to_dict()
                              if sentinels is not None else None)
+    if hasattr(sim, "chaos"):
+        # The active ChaosConfig (simulation.faults) or None: the
+        # scheduled fault plane this run executed under — what a bundle
+        # or report consumer needs to interpret the "chaos" failure
+        # cause and the chaos_* recovery vitals.
+        chaos = sim.chaos
+        snap["chaos"] = chaos.to_dict() if chaos is not None else None
     return snap
 
 

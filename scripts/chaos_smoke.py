@@ -1,0 +1,185 @@
+"""CI chaos smoke: partition → heal → prove reconvergence, with artifacts.
+
+Runs a tiny (seconds on one CPU core) chaos-scenario gossip simulation —
+the population split into two components at round 3, healed at round 6 —
+with consensus probes and the scheduled-fault layer on, then SELF-CHECKS
+the recovery evidence:
+
+- the per-round partition consensus gap (``chaos_component_gap``) is ~0
+  before the partition, OPENS while it holds, and RECONVERGES after the
+  heal (:func:`gossipy_tpu.simulation.rounds_to_reconverge` names the
+  round count);
+- the jitted trajectory is bit-identical when re-run chunked through two
+  ``start()`` calls crossing the heal boundary (chaos determinism);
+- the sequential high-fidelity engine agrees on the structural story
+  (gap open during the window, closed after) for the same config.
+
+Writes into ``--out DIR``: ``report.json`` (the full chaos-enabled
+SimulationReport, schema v5), ``chaos_verdict.json`` (the self-check
+summary: per-round gap, rounds-to-reconverge, both engines' verdicts) and
+``events.jsonl`` (schema-v5 rows with the ``chaos`` field). Exits
+non-zero on any failed check; ``.github/workflows/ci.yml`` uploads the
+directory either way so a red run ships its own evidence.
+
+Usage: ``python scripts/chaos_smoke.py --out chaos-artifacts``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+N_NODES = 16
+PART_START, PART_STOP = 3, 6   # partition at round 3, heal at round 6
+ROUNDS = 14
+
+
+def build(cls, **kwargs):
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+        Topology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation.faults import ChaosConfig, PartitionEpisode
+
+    rng = np.random.default_rng(7)
+    D = 6
+    X = rng.normal(size=(480, D)).astype(np.float32)
+    y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=N_NODES, eval_on_user=False)
+    handler = SGDHandler(
+        model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+        optimizer=optax.sgd(0.2), local_epochs=1, batch_size=16,
+        n_classes=2, input_shape=(D,),
+        create_model_mode=CreateModelMode.MERGE_UPDATE)
+    half = N_NODES // 2
+    chaos = ChaosConfig(partitions=(PartitionEpisode(
+        components=(tuple(range(half)), tuple(range(half, N_NODES))),
+        start=PART_START, stop=PART_STOP),))
+    return cls(handler, Topology.clique(N_NODES), disp.stacked(),
+               delta=20, protocol=AntiEntropyProtocol.PUSH,
+               probes=True, chaos=chaos, **kwargs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="chaos-artifacts")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+
+    import jax
+
+    from gossipy_tpu.simulation import (
+        GossipSimulator,
+        JSONLinesReceiver,
+        SequentialGossipSimulator,
+        rounds_to_reconverge,
+    )
+
+    checks: dict = {}
+    failures: list = []
+
+    def check(name, ok, detail=None):
+        checks[name] = {"ok": bool(ok), "detail": detail}
+        if not ok:
+            failures.append(name)
+        print(f"[chaos-smoke] {'ok ' if ok else 'FAIL'} {name}"
+              + (f" ({detail})" if detail is not None else ""))
+
+    key = jax.random.PRNGKey(11)
+
+    # One-shot jitted run (with JSONL so the artifact carries the schema
+    # v5 chaos rows).
+    sim = build(GossipSimulator)
+    events_path = os.path.join(args.out, "events.jsonl")
+    if os.path.exists(events_path):
+        os.remove(events_path)
+    with JSONLinesReceiver(events_path) as rx:
+        sim.add_receiver(rx)
+        st = sim.init_nodes(key)
+        st, rep = sim.start(st, n_rounds=ROUNDS, key=key,
+                            donate_state=False)
+        sim.remove_receiver(rx)
+    rep.save(os.path.join(args.out, "report.json"))
+
+    gap = np.asarray(rep.chaos_component_gap, dtype=np.float64)
+    pre = float(gap[:PART_START].max())
+    during = float(gap[PART_START:PART_STOP].min())
+    # Post-heal the gap decays toward the ongoing-SGD noise floor (the
+    # halves keep training on disjoint shards), so reconvergence is the
+    # post-heal MINIMUM dipping well under the partition peak.
+    post = float(gap[PART_STOP:].min())
+    peak = float(gap[PART_START:PART_STOP].max())
+    check("gap_opens_during_partition", during > max(10.0 * pre, 1e-4),
+          f"pre<= {pre:.2e}, during>= {during:.3f}")
+    check("gap_closes_after_heal", post < 0.25 * peak,
+          f"peak {peak:.3f} -> post-heal min {post:.3f}")
+    recon = rounds_to_reconverge(gap, PART_STOP, tol=0.25 * peak)
+    check("reconverges_within_report", recon is not None,
+          f"rounds_to_reconverge={recon}")
+
+    # Chunked determinism across the heal boundary: 5 + (ROUNDS-5) rounds
+    # through two start() calls must reproduce the one-shot trajectory
+    # bit for bit (randomness and the schedule key on absolute rounds).
+    sim2 = build(GossipSimulator)
+    st2 = sim2.init_nodes(key)
+    st2, r1 = sim2.start(st2, n_rounds=5, key=key, donate_state=False)
+    st2, r2 = sim2.start(st2, n_rounds=ROUNDS - 5, key=key,
+                         donate_state=False)
+    chunked_gap = np.concatenate([np.asarray(r1.chaos_component_gap),
+                                  np.asarray(r2.chaos_component_gap)])
+    check("chunked_resume_bit_identical",
+          np.array_equal(chunked_gap, gap)
+          and np.array_equal(
+              np.concatenate([r1.sent_per_round, r2.sent_per_round]),
+              rep.sent_per_round))
+
+    # Sequential-engine structural parity on the same scenario.
+    seq = build(SequentialGossipSimulator)
+    sst = seq.init_nodes(key)
+    sst, srep = seq.start(sst, n_rounds=ROUNDS, key=key)
+    sgap = np.asarray(srep.chaos_component_gap, dtype=np.float64)
+    speak = float(sgap[PART_START:PART_STOP].max())
+    spost = float(sgap[PART_STOP:].min())
+    check("sequential_gap_opens_and_closes",
+          float(sgap[PART_START:PART_STOP].min()) > 1e-4
+          and spost < 0.25 * speak,
+          f"seq peak {speak:.3f} -> post-heal min {spost:.3f}")
+
+    verdict = {
+        "n_nodes": N_NODES,
+        "partition": {"start": PART_START, "stop": PART_STOP},
+        "rounds": ROUNDS,
+        "gap_per_round": [round(float(g), 6) for g in gap],
+        "sequential_gap_per_round": [round(float(g), 6) for g in sgap],
+        "rounds_to_reconverge_after_heal": recon,
+        "failed_by_cause_keys": sorted(rep.failed_per_cause),
+        "checks": checks,
+        "ok": not failures,
+    }
+    with open(os.path.join(args.out, "chaos_verdict.json"), "w") as fh:
+        json.dump(verdict, fh, indent=2)
+        fh.write("\n")
+
+    if failures:
+        print(f"[chaos-smoke] FAILED checks: {failures}", file=sys.stderr)
+        return 1
+    print(f"[chaos-smoke] all checks passed; gap peak {peak:.3f}, "
+          f"reconverged {recon} round(s) after heal; artifacts in "
+          f"{args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,624 @@
+"""Chaos layer: scheduled fault injection, dynamic topologies, recovery.
+
+Covers the ISSUE-7 acceptance criteria:
+
+- ``chaos=None`` traces HLO identical to a build without the argument
+  (the probes/sentinels discipline), and enabling chaos leaves the
+  chaos-free rounds' accounting untouched;
+- a partition/heal scenario opens the per-component consensus gap during
+  the window and reconverges after the heal, with jitted-vs-sequential
+  parity (exact where the regime is deterministic, structural otherwise);
+- every fault type (outage, partition, churn, drop/delay spikes) has
+  deterministic jitted-vs-sequential agreement on its signature;
+- same seed + same ChaosConfig → bit-identical trajectories across
+  chunked ``start()`` calls and after a FlightRecorder ``replay_bundle``
+  restore mid-episode, with the bundle verdict naming the fault window;
+- chaos fields ride the report registry (save → load → concatenate), the
+  schema-v5 JSONL, and the ``update_chaos`` event stream;
+- the declarative config round-trips through ExperimentConfig, and the
+  service packer buckets by schedule SHAPE while tenants vary VALUES.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import (
+    AntiEntropyProtocol,
+    ConstantDelay,
+    CreateModelMode,
+    SparseTopology,
+    Topology,
+    uniform_mixing,
+)
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, WeightedSGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import (
+    All2AllGossipSimulator,
+    ChaosConfig,
+    ChurnProcess,
+    FaultSpike,
+    GossipSimulator,
+    JSONLinesReceiver,
+    OutageEpisode,
+    PartitionEpisode,
+    SequentialGossipSimulator,
+    SimulationEventReceiver,
+    SimulationReport,
+    rounds_to_reconverge,
+)
+from gossipy_tpu.simulation.faults import build_fault_schedule
+
+N, D = 8, 4
+HALF = ((0, 1, 2, 3), (4, 5, 6, 7))
+
+
+def make_data(seed=0, n_samples=160):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_samples, D)).astype(np.float32)
+    y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+    return X, y
+
+
+def make_handler(lr=0.0):
+    return SGDHandler(model=LogisticRegression(D, 2),
+                      loss=losses.cross_entropy, optimizer=optax.sgd(lr),
+                      local_epochs=1, batch_size=8, n_classes=2,
+                      input_shape=(D,),
+                      create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+
+def make_sim(cls=GossipSimulator, lr=0.0, topo=None, **kwargs):
+    X, y = make_data()
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=N, eval_on_user=False)
+    topo = topo if topo is not None else Topology.clique(N)
+    return cls(make_handler(lr), topo, disp.stacked(), delta=20,
+               protocol=AntiEntropyProtocol.PUSH, **kwargs)
+
+
+def craft_two_blocks(sim, state, a=1.0, b=3.0):
+    """Overwrite params so nodes 0-3 carry the constant ``a`` and 4-7 the
+    constant ``b`` — with lr=0 pure averaging, any value outside {a, b,
+    their mixtures} proves an unscheduled information path."""
+    vals = jnp.where(jnp.arange(N) < 4, a, b)
+    params = jax.tree.map(
+        lambda l: jnp.broadcast_to(
+            vals.reshape((N,) + (1,) * (l.ndim - 1)), l.shape
+        ).astype(l.dtype),
+        state.model.params)
+    return state._replace(model=state.model._replace(params=params))
+
+
+def craft_two_blocks_seq(state, a=1.0, b=3.0):
+    for i in range(N):
+        v = a if i < 4 else b
+        state.models[i] = state.models[i]._replace(
+            params=jax.tree.map(lambda l: jnp.full(l.shape, v, l.dtype),
+                                state.models[i].params))
+    return state
+
+
+def first_leaf_values(params):
+    """[N] first scalar of each node's first param leaf."""
+    leaf = jax.tree_util.tree_leaves(params)[0]
+    return np.asarray(leaf).reshape(N, -1)[:, 0]
+
+
+PARTITION = ChaosConfig(partitions=(
+    PartitionEpisode(components=HALF, start=2, stop=5),))
+
+
+class TestChaosConfig:
+    def test_round_trip_and_coerce(self):
+        cfg = ChaosConfig(
+            outages=(OutageEpisode(nodes=(1, 2), start=0, stop=3),),
+            partitions=(PartitionEpisode(components=HALF, start=2,
+                                         stop=5),),
+            churn=ChurnProcess(keep_frac=0.5, start=1, stop=4, period=2),
+            spikes=(FaultSpike(start=3, stop=4, drop_prob=0.9,
+                               delay_scale=2.0),))
+        d = cfg.to_dict()
+        json.dumps(d)  # JSON-able
+        back = ChaosConfig.from_dict(d)
+        assert back == cfg
+        assert ChaosConfig.coerce(None) is None
+        assert ChaosConfig.coerce(cfg) is cfg
+        assert ChaosConfig.coerce(d) == cfg
+        with pytest.raises(TypeError):
+            ChaosConfig.coerce("partition")
+        assert cfg.horizon == 5
+        assert cfg.max_components() == 3  # two listed + implicit
+        assert cfg.max_delay_scale() == 2.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="schedules nothing"):
+            ChaosConfig()
+        with pytest.raises(ValueError, match="window"):
+            OutageEpisode(nodes=(0,), start=3, stop=3)
+        with pytest.raises(ValueError, match="disjoint"):
+            PartitionEpisode(components=((0, 1), (1, 2)), start=0, stop=2)
+        with pytest.raises(ValueError, match="keep_frac"):
+            ChurnProcess(keep_frac=1.5, start=0, stop=2)
+        with pytest.raises(ValueError, match="drop_prob"):
+            FaultSpike(start=0, stop=1, drop_prob=2.0)
+        with pytest.raises(ValueError, match="horizon"):
+            ChaosConfig(spikes=(FaultSpike(start=0, stop=9,
+                                           drop_prob=0.5),), horizon=3)
+        with pytest.raises(ValueError, match="unknown chaos fields"):
+            ChaosConfig.from_dict({"partitons": []})
+
+    def test_active_at_names_windows(self):
+        cfg = ChaosConfig(
+            outages=(OutageEpisode(nodes=(1,), start=1, stop=3),),
+            spikes=(FaultSpike(start=2, stop=4, drop_prob=0.5),))
+        assert cfg.active_at(0) == []
+        kinds = [w["kind"] for w in cfg.active_at(2)]
+        assert kinds == ["outage", "spike"]
+        assert cfg.active_at(4) == []
+
+    def test_schedule_tables(self):
+        topo = Topology.clique(N)
+        sched = build_fault_schedule(PARTITION, topo, 0.1)
+        assert sched.rows == PARTITION.horizon + 1
+        # Trailing baseline row: nothing forced, mask 0, base drop.
+        assert not sched.forced_offline[-1].any()
+        assert sched.mask_idx[-1] == 0
+        assert sched.drop_prob[-1] == np.float32(0.1)
+        # Partition rounds share one deduplicated mask.
+        assert sched.mask_idx[2] == sched.mask_idx[3] == sched.mask_idx[4]
+        assert sched.mask_idx[0] == 0 and sched.mask_idx[1] == 0
+        m = sched.edge_masks[sched.mask_idx[2]]
+        assert not m[0, 4] and not m[4, 0] and m[0, 1] and m[4, 5]
+        # Component ids persist past the heal (the probe keeps measuring
+        # the former components' gap so reconvergence is observable).
+        assert (sched.component_id[2] == sched.component_id[-1]).all()
+
+
+class TestChaosOffIsUntouched:
+    def test_chaos_off_hlo_identical(self):
+        sim_default = make_sim()
+        sim_off = make_sim(chaos=None)
+        key = jax.random.PRNGKey(0)
+        st = sim_default.init_nodes(key)
+        hlo_a = sim_default.lower_start(st, n_rounds=2, key=key).as_text()
+        hlo_b = sim_off.lower_start(st, n_rounds=2, key=key).as_text()
+        assert hlo_a == hlo_b
+
+    def test_report_has_no_chaos_fields_by_default(self):
+        sim = make_sim(lr=0.1)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        _, rep = sim.start(st, n_rounds=2, key=key)
+        assert rep.chaos_component_gap is None
+        assert "chaos" not in rep.failed_per_cause
+
+
+class TestPartitionHealReconverge:
+    """The acceptance scenario: gap opens during the partition, closes
+    after the heal, with jitted-vs-sequential parity. lr=0 + crafted
+    two-block params make the during-partition regime DETERMINISTIC:
+    averaging identical values keeps every node exactly at its block's
+    value, so any leak across the cut is a hard failure."""
+
+    def _run(self, cls):
+        sim = make_sim(cls=cls, lr=0.0, chaos=PARTITION, probes=True)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key, local_train=False, common_init=True)
+        if cls is GossipSimulator:
+            st = craft_two_blocks(sim, st)
+        else:
+            st = craft_two_blocks_seq(st)
+        return sim.start(st, n_rounds=10, key=key)
+
+    @pytest.mark.parametrize("cls", [GossipSimulator,
+                                     SequentialGossipSimulator])
+    def test_gap_opens_then_reconverges(self, cls):
+        st, rep = self._run(cls)
+        gap = np.asarray(rep.chaos_component_gap, np.float64)
+        # Pre-partition (rounds 0-1): the scheduled component grouping
+        # only exists from round 2 (persisting after the heal), so the
+        # gap column is structurally 0 before it.
+        assert (gap[:2] == 0).all()
+        # While the window holds the halves cannot exchange: the gap
+        # stays open (within-component averaging drifts the component
+        # means, so it wobbles but cannot close); the heal closes it.
+        during = gap[2:5]
+        assert during.min() > 0.1 * during.max() > 0
+        assert gap[-1] < 0.5 * during.max()
+        assert rounds_to_reconverge(gap, 5, tol=0.5 * during.max()) \
+            is not None
+
+    @pytest.mark.parametrize("cls", [GossipSimulator,
+                                     SequentialGossipSimulator])
+    def test_no_cross_partition_leak(self, cls):
+        """Crafted blocks + a partition from round 0: while the window
+        holds, every node's params stay EXACTLY at its block value in
+        both engines (averaging identical values is the identity)."""
+        cfg = ChaosConfig(partitions=(
+            PartitionEpisode(components=HALF, start=0, stop=4),))
+        sim = make_sim(cls=cls, lr=0.0, chaos=cfg, probes=True)
+        key = jax.random.PRNGKey(1)
+        st = sim.init_nodes(key, local_train=False, common_init=True)
+        st = (craft_two_blocks(sim, st) if cls is GossipSimulator
+              else craft_two_blocks_seq(st))
+        st, rep = sim.start(st, n_rounds=3, key=key)
+        params = (st.model.params if cls is GossipSimulator
+                  else jax.tree.map(lambda *ls: jnp.stack(ls),
+                                    *[m.params for m in st.models]))
+        vals = first_leaf_values(params)
+        np.testing.assert_array_equal(vals[:4], np.full(4, 1.0))
+        np.testing.assert_array_equal(vals[4:], np.full(4, 3.0))
+        # And the gap equals the crafted block distance, identically in
+        # both engines (same pure chaos_round_stats math).
+        assert np.allclose(rep.chaos_component_gap,
+                           rep.chaos_component_gap[0])
+        # Sends kept flowing within components the whole time.
+        assert (rep.sent_per_round == N).all()
+
+    def test_all2all_partition_gap(self):
+        X, y = make_data()
+        dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+        disp = DataDispatcher(dh, n=N, eval_on_user=False)
+        topo = Topology.clique(N)
+        handler = WeightedSGDHandler(
+            model=LogisticRegression(D, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.0), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(D,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+        cfg = ChaosConfig(partitions=(
+            PartitionEpisode(components=HALF, start=0, stop=3),))
+        sim = All2AllGossipSimulator(handler, topo, disp.stacked(),
+                                     delta=20, mixing=uniform_mixing(topo),
+                                     chaos=cfg, probes=True)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key, local_train=False, common_init=True)
+        st = craft_two_blocks(sim, st)
+        st, rep = sim.start(st, n_rounds=6, key=key)
+        gap = np.asarray(rep.chaos_component_gap)
+        # Broadcast mixing within each half is the identity on crafted
+        # blocks; the heal mixes the whole clique in one round.
+        np.testing.assert_allclose(gap[:3], gap[0], rtol=1e-5)
+        assert gap[0] > 0
+        assert gap[-1] < 0.05 * gap[0]
+        vals = first_leaf_values(st.model.params)
+        assert np.allclose(vals, vals[0])  # full consensus post-heal
+
+
+class TestOutage:
+    CFG = ChaosConfig(outages=(OutageEpisode(nodes=(5, 6, 7), start=1,
+                                             stop=4),))
+
+    @pytest.mark.parametrize("cls", [GossipSimulator,
+                                     SequentialGossipSimulator])
+    def test_forced_nodes_freeze_and_chaos_cause_counts(self, cls):
+        sim = make_sim(cls=cls, lr=0.0, chaos=self.CFG)
+        key = jax.random.PRNGKey(2)
+        st = sim.init_nodes(key, local_train=False)
+        pre = (first_leaf_values(st.model.params)
+               if cls is GossipSimulator else
+               first_leaf_values(jax.tree.map(
+                   lambda *ls: jnp.stack(ls),
+                   *[m.params for m in st.models])))
+        # Run EXACTLY the outage window: rounds 1..3 (round 0 mixes).
+        st, rep1 = sim.start(st, n_rounds=1, key=key)
+        mid = (first_leaf_values(st.model.params)
+               if cls is GossipSimulator else
+               first_leaf_values(jax.tree.map(
+                   lambda *ls: jnp.stack(ls),
+                   *[m.params for m in st.models])))
+        st, rep2 = sim.start(st, n_rounds=3, key=key)
+        post = (first_leaf_values(st.model.params)
+                if cls is GossipSimulator else
+                first_leaf_values(jax.tree.map(
+                    lambda *ls: jnp.stack(ls),
+                    *[m.params for m in st.models])))
+        # Forced-offline nodes neither received nor trained: frozen.
+        np.testing.assert_array_equal(mid[5:], post[5:])
+        # The chaos cause counted their would-be deliveries, only inside
+        # the window.
+        assert rep1.failed_per_cause["chaos"].sum() == 0
+        assert rep2.failed_per_cause["chaos"].sum() > 0
+        total = sum(rep2.failed_per_cause.values())
+        np.testing.assert_array_equal(total, rep2.failed_per_round)
+        # Outage sends are suppressed too: 5 senders instead of 8.
+        assert (rep2.sent_per_round == np.array([5, 5, 5])).all()
+        assert rep1.sent_per_round[0] == N
+
+
+class TestSpikesAndChurn:
+    @pytest.mark.parametrize("cls", [GossipSimulator,
+                                     SequentialGossipSimulator])
+    def test_total_drop_spike_window_is_exact(self, cls):
+        cfg = ChaosConfig(spikes=(FaultSpike(start=1, stop=3,
+                                             drop_prob=1.0),))
+        sim = make_sim(cls=cls, lr=0.0, chaos=cfg)
+        key = jax.random.PRNGKey(3)
+        st = sim.init_nodes(key, local_train=False)
+        st, rep = sim.start(st, n_rounds=5, key=key)
+        drops = rep.failed_per_cause["drop"]
+        # Deterministic signature on both engines: every message sent in
+        # the window drops; none outside (base drop_prob = 0).
+        np.testing.assert_array_equal(drops[1:3], rep.sent_per_round[1:3])
+        assert drops[0] == 0 and (drops[3:] == 0).all()
+        assert (rep.sent_per_round == N).all()
+
+    @pytest.mark.parametrize("cls", [GossipSimulator,
+                                     SequentialGossipSimulator])
+    def test_delay_spike_shifts_staleness(self, cls):
+        # Base delay = one round; a 2x spike in rounds [1, 3) makes
+        # those sends arrive two rounds stale — bucket 2 traffic exists
+        # exactly for spiked sends, on both engines.
+        cfg = ChaosConfig(spikes=(FaultSpike(start=1, stop=3,
+                                             delay_scale=2.0),))
+        sim = make_sim(cls=cls, lr=0.0, chaos=cfg, probes=True,
+                       delay=ConstantDelay(20))
+        key = jax.random.PRNGKey(4)
+        st = sim.init_nodes(key, local_train=False)
+        st, rep = sim.start(st, n_rounds=6, key=key)
+        hist = np.asarray(rep.probe_stale_hist)
+        # Rounds 1,2 sends (spiked) land at rounds 3,4 with staleness 2;
+        # unspiked sends land one round later with staleness 1.
+        assert hist[3, 2] == N and hist[4, 2] == N
+        assert hist[1, 1] == N          # round-0 send, unspiked
+        assert hist[5, 1] == N          # round-4 send, after the spike
+        assert (hist[:, 0] == 0).all()  # base delay is a full round
+
+    @pytest.mark.parametrize("cls", [GossipSimulator,
+                                     SequentialGossipSimulator])
+    def test_total_churn_silences_sends(self, cls):
+        cfg = ChaosConfig(churn=ChurnProcess(keep_frac=0.0, start=1,
+                                             stop=3))
+        sim = make_sim(cls=cls, lr=0.0, chaos=cfg)
+        key = jax.random.PRNGKey(5)
+        st = sim.init_nodes(key, local_train=False)
+        st, rep = sim.start(st, n_rounds=5, key=key)
+        # keep_frac=0: every edge down in the window — nobody has an
+        # alive peer, so nobody sends; edges return at round 3.
+        np.testing.assert_array_equal(rep.sent_per_round,
+                                      [N, 0, 0, N, N])
+
+    def test_churn_epochs_are_deterministic_and_rewire(self):
+        topo = Topology.clique(N)
+        cfg = ChaosConfig(churn=ChurnProcess(keep_frac=0.5, start=0,
+                                             stop=6, period=2, seed=9))
+        s1 = build_fault_schedule(cfg, topo, 0.0)
+        s2 = build_fault_schedule(cfg, topo, 0.0)
+        for f in ("mask_idx", "edge_masks", "forced_offline"):
+            np.testing.assert_array_equal(getattr(s1, f), getattr(s2, f))
+        # Period 2: rounds (0,1), (2,3), (4,5) share masks; epochs
+        # differ from each other (w.h.p. at 28 pairs, keep 0.5).
+        mi = s1.mask_idx
+        assert mi[0] == mi[1] and mi[2] == mi[3] and mi[4] == mi[5]
+        assert len({int(mi[0]), int(mi[2]), int(mi[4])}) == 3
+        # Masks are symmetric modifiers.
+        m = s1.edge_masks[mi[0]]
+        np.testing.assert_array_equal(m, m.T)
+
+    def test_sparse_topology_masks_are_o_e(self):
+        topo = SparseTopology.ring(N, 2)
+        cfg = ChaosConfig(partitions=(
+            PartitionEpisode(components=HALF, start=0, stop=3),))
+        sched = build_fault_schedule(cfg, topo, 0.0)
+        assert isinstance(sched.edge_masks, tuple)  # no dense [N, N]
+        assert sched.csr_masks.shape[1] == len(topo.indices)
+        # And the engine runs on it end to end.
+        sim = make_sim(lr=0.0, topo=topo, chaos=cfg, probes=True)
+        key = jax.random.PRNGKey(6)
+        st = sim.init_nodes(key, local_train=False, common_init=True)
+        st = craft_two_blocks(sim, st)
+        st, rep = sim.start(st, n_rounds=5, key=key)
+        vals = first_leaf_values(st.model.params)
+        assert not np.allclose(vals, vals[0])  # ring heals slowly
+        gap = np.asarray(rep.chaos_component_gap)
+        assert gap[0] > 0 and gap[-1] < gap[0]
+
+    def test_pens_rejects_edge_faults(self):
+        from gossipy_tpu.simulation import PENSGossipSimulator
+        with pytest.raises(ValueError, match="_select_peers"):
+            make_sim(cls=PENSGossipSimulator, lr=0.1, chaos=PARTITION)
+
+
+class TestDeterminismAndReplay:
+    def _mk(self):
+        cfg = ChaosConfig(partitions=(
+            PartitionEpisode(components=HALF, start=0, stop=6),))
+        return make_sim(lr=0.0, chaos=cfg, sentinels=True, probes=True)
+
+    def test_chunked_start_bit_identical(self):
+        key = jax.random.PRNGKey(7)
+        a = self._mk()
+        st = craft_two_blocks(a, a.init_nodes(key, local_train=False,
+                                              common_init=True))
+        _, rep = a.start(st, n_rounds=8, key=key, donate_state=False)
+        b = self._mk()
+        st2 = craft_two_blocks(b, b.init_nodes(key, local_train=False,
+                                               common_init=True))
+        st2, r1 = b.start(st2, n_rounds=3, key=key, donate_state=False)
+        st2, r2 = b.start(st2, n_rounds=5, key=key, donate_state=False)
+        cat = SimulationReport.concatenate([r1, r2])
+        np.testing.assert_array_equal(rep.chaos_component_gap,
+                                      cat.chaos_component_gap)
+        np.testing.assert_array_equal(rep.sent_per_round,
+                                      cat.sent_per_round)
+        for c in rep.failed_per_cause:
+            np.testing.assert_array_equal(rep.failed_per_cause[c],
+                                          cat.failed_per_cause[c])
+
+    def test_chaos_induced_trip_bundle_and_replay(self, tmp_path):
+        """The acceptance repro loop: a heal-induced divergence trip is
+        captured mid-episode by the flight recorder (bundle names the
+        partition window active at the checkpoint round) and replays
+        bit-for-bit on a FRESH simulator built from the same config."""
+        from gossipy_tpu.telemetry.health import FlightRecorder, \
+            replay_bundle
+        key = jax.random.PRNGKey(5)
+        sim = self._mk()
+        # Norm asymmetry: the heal merges norm~57 params into norm~0.7
+        # nodes — a >10x jump over their settled EMA trips divergence.
+        st = craft_two_blocks(sim, sim.init_nodes(
+            key, local_train=False, common_init=True), a=0.5, b=40.0)
+        rec = FlightRecorder(str(tmp_path), chunk=4)
+        st, reports, bundle = rec.run(sim, st, n_rounds=12, key=key)
+        assert bundle is not None
+        with open(os.path.join(bundle, "verdict.json")) as fh:
+            verdict = json.load(fh)
+        assert verdict["kind"] == "sentinel"
+        assert verdict["first_bad_round"] >= 6  # at/after the heal
+        # The checkpoint round (4, mid-partition) names the window.
+        ck = verdict["detail"]["chaos_windows_at_checkpoint"]
+        assert [w["kind"] for w in ck] == ["partition"]
+        assert ck[0]["start"] == 0 and ck[0]["stop"] == 6
+
+        fresh = self._mk()
+        out = replay_bundle(bundle, fresh)
+        assert out["matches_recorded"] is True
+        assert out["trip"] == "divergence"
+        assert out["start_round"] == 4  # restored mid-episode
+
+
+class ChaosRecorder(SimulationEventReceiver):
+    def __init__(self):
+        self.rows = []
+
+    def update_chaos(self, round, chaos):
+        self.rows.append((round, chaos))
+
+
+class TestReportEventsAndConfig:
+    def _rep(self, **kw):
+        sim = make_sim(lr=0.1, chaos=PARTITION, probes=True, **kw)
+        key = jax.random.PRNGKey(0)
+        st = sim.init_nodes(key)
+        return sim, sim.start(st, n_rounds=6, key=key)[1]
+
+    def test_report_round_trip_and_concat(self, tmp_path):
+        _, rep = self._rep()
+        path = str(tmp_path / "rep.json")
+        rep.save(path)
+        loaded = SimulationReport.load(path)
+        for f in ("chaos_component_gap", "chaos_within_mean",
+                  "chaos_active_components"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(loaded, f), np.float64),
+                np.asarray(getattr(rep, f), np.float64), atol=1e-6,
+                err_msg=f)
+        np.testing.assert_array_equal(loaded.failed_per_cause["chaos"],
+                                      rep.failed_per_cause["chaos"])
+        cat = SimulationReport.concatenate([loaded, loaded])
+        assert cat.chaos_component_gap.shape[0] == 12
+        assert cat.failed_per_cause["chaos"].shape[0] == 12
+
+    def test_update_chaos_events_and_jsonl_v5(self, tmp_path):
+        sim = make_sim(lr=0.1, chaos=PARTITION, probes=True)
+        rec = ChaosRecorder()
+        path = str(tmp_path / "run.jsonl")
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rec)
+            sim.add_receiver(rx)
+            key = jax.random.PRNGKey(0)
+            st = sim.init_nodes(key)
+            sim.start(st, n_rounds=4, key=key)
+        assert [r for r, _ in rec.rows] == [1, 2, 3, 4]
+        assert all({"component_gap", "within_mean", "active_components",
+                    "failed_chaos"} <= set(row) for _, row in rec.rows)
+        rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
+        assert all(r["schema"] == 5 for r in rows)
+        assert all(r["chaos"] is not None for r in rows)
+        assert all("chaos" in r["failed_by_cause"] for r in rows)
+        # Pre-v5 lines normalize with a null chaos field.
+        old = json.dumps({"schema": 4, "round": 1, "sent": 0, "failed": 0,
+                          "failed_by_cause": None, "probes": None,
+                          "health": None, "size": 0, "local": None,
+                          "global": None})
+        assert JSONLinesReceiver.parse_line(old)["chaos"] is None
+
+    def test_experiment_config_carries_chaos(self):
+        from gossipy_tpu.config import ExperimentConfig, run_experiment
+        X, y = make_data()
+        cfg = ExperimentConfig(
+            n_nodes=N, model="logreg", handler="sgd", topology="clique",
+            topology_params={}, delta=20, n_rounds=4, seed=3,
+            batch_size=8, simulator_params={"probes": True},
+            chaos={"partitions": [
+                {"components": [list(HALF[0]), list(HALF[1])],
+                 "start": 1, "stop": 3}]})
+        # Round-trips through JSON like every other field.
+        back = ExperimentConfig.from_json(cfg.to_json())
+        assert back.chaos == cfg.chaos
+        # chaos is tenant-variable: not part of the shape fields.
+        assert "chaos" not in cfg.shape_fields()
+        _, rep = run_experiment(cfg, data=(X, y))
+        assert rep.chaos_component_gap is not None
+        assert (np.asarray(rep.chaos_active_components)[1:3] == 2).all()
+        bad = ExperimentConfig(
+            n_nodes=N, chaos={"nope": 1}, topology="clique",
+            topology_params={})
+        with pytest.raises(ValueError, match="unknown chaos fields"):
+            run_experiment(bad, data=(X, y))
+
+
+@pytest.mark.slow
+class TestServiceChaos:
+    def test_same_shape_chaos_tenants_share_a_bucket(self, tmp_path):
+        """Two tenants whose chaos configs differ in VALUES (partition
+        membership) but not shapes pack into ONE megabatch; each lane's
+        trajectory equals its solo run bit-for-bit."""
+        import dataclasses
+
+        from gossipy_tpu.config import ExperimentConfig, run_experiment
+        from gossipy_tpu.service import GossipService, RunQueue, \
+            RunRequest
+        X, y = make_data(seed=3)
+
+        def cfg(seed, comps):
+            return ExperimentConfig(
+                n_nodes=N, model="logreg", handler="sgd",
+                topology="clique", topology_params={}, delta=20,
+                n_rounds=6, seed=seed, learning_rate=0.2, batch_size=8,
+                simulator_params={"probes": True},
+                chaos={"partitions": [{"components": comps,
+                                       "start": 2, "stop": 4}]})
+
+        ca = cfg(1, [[0, 1, 2, 3], [4, 5, 6, 7]])
+        cb = cfg(2, [[0, 2, 4, 6], [1, 3, 5, 7]])
+        svc = GossipService(out_dir=str(tmp_path), slice_rounds=3)
+        q = RunQueue()
+        handles = [q.submit(RunRequest("alice", ca, data=(X, y))),
+                   q.submit(RunRequest("bob", cb, data=(X, y)))]
+        summary = svc.serve(q)
+        assert summary["n_buckets"] == 1
+        for h, c in zip(handles, (ca, cb)):
+            assert h.status.value == "done"
+            solo = dataclasses.replace(
+                c, simulator_params={**c.simulator_params,
+                                     "sentinels": True})
+            _, rep = run_experiment(solo, data=(X, y))
+            np.testing.assert_array_equal(
+                np.asarray(h.report.chaos_component_gap),
+                np.asarray(rep.chaos_component_gap))
+
+    def test_different_horizon_splits_buckets(self, tmp_path):
+        from gossipy_tpu.service.packer import build_request, pack
+        from gossipy_tpu.service.spec import RunRequest
+        from gossipy_tpu.config import ExperimentConfig
+        X, y = make_data(seed=3)
+
+        def cfg(seed, stop):
+            return ExperimentConfig(
+                n_nodes=N, model="logreg", handler="sgd",
+                topology="clique", topology_params={}, delta=20,
+                n_rounds=6, seed=seed, batch_size=8,
+                chaos={"partitions": [{
+                    "components": [[0, 1, 2, 3], [4, 5, 6, 7]],
+                    "start": 1, "stop": stop}]})
+
+        built = [build_request(RunRequest("a", cfg(1, 3), data=(X, y))),
+                 build_request(RunRequest("b", cfg(2, 5), data=(X, y)))]
+        assert len(pack(built)) == 2  # horizon differs -> shape splits

@@ -396,6 +396,87 @@ class TestKMeansHandler:
                                    atol=0.1)
 
 
+class TestKMeansMatching:
+    """Greedy-vs-exact assignment divergence (ISSUE-7 satellite): the
+    jitted merge path keeps ``greedy_match`` (shape-static, in-trace); the
+    eager path upgrades to the exact Hungarian solver. These tests
+    QUANTIFY when the two agree and how far greedy can stray — the
+    tradeoff documented in the handler module docstring."""
+
+    @staticmethod
+    def _assign_cost(cost, match):
+        cost = np.asarray(cost, np.float64)
+        return float(cost[np.arange(cost.shape[0]), np.asarray(match)].sum())
+
+    def test_greedy_is_exact_when_well_separated(self):
+        # The gossip regime: peers' centroids are noisy copies of the
+        # same well-separated truth, so each row's true partner is its
+        # global nearest and greedy provably finds the optimum. 50 random
+        # instances, k=4: match-for-match identical.
+        from gossipy_tpu.handlers.kmeans import exact_match, greedy_match
+        rng = np.random.default_rng(0)
+        for trial in range(50):
+            truth = rng.uniform(-10, 10, size=(4, 3))
+            c1 = truth + rng.normal(0, 0.05, size=truth.shape)
+            perm = rng.permutation(4)
+            c2 = truth[perm] + rng.normal(0, 0.05, size=truth.shape)
+            cost = np.sqrt(((c1[:, None] - c2[None]) ** 2).sum(-1))
+            g = np.asarray(greedy_match(jnp.asarray(cost, jnp.float32)))
+            e = exact_match(cost)
+            np.testing.assert_array_equal(g, e, err_msg=f"trial {trial}")
+
+    def test_greedy_divergence_is_unbounded_on_crafted_costs(self):
+        # The failure mode: greedy locks the globally-cheapest pair even
+        # when it forces an arbitrarily expensive completion. Here
+        # greedy pays 100 + 1 where the optimum pays 1 + 1 — a 50x
+        # excess, scalable without limit by inflating the corner.
+        from gossipy_tpu.handlers.kmeans import exact_match, greedy_match
+        cost = np.array([[0.0, 1.0], [1.0, 100.0]])
+        g = np.asarray(greedy_match(jnp.asarray(cost, jnp.float32)))
+        e = exact_match(cost)
+        gc, ec = self._assign_cost(cost, g), self._assign_cost(cost, e)
+        np.testing.assert_array_equal(g, [0, 1])  # locks the 0.0 corner
+        np.testing.assert_array_equal(e, [1, 0])
+        assert gc == 100.0 and ec == 2.0
+        assert gc / ec == 50.0
+
+    def test_exact_never_loses_and_quantifies_mean_excess(self):
+        # Exact is a true lower bound on every instance; on UNSTRUCTURED
+        # random costs (no well-separated geometry) greedy's mean excess
+        # is small but nonzero — the quantified gap a hungarian-matching
+        # user accepts inside jit.
+        from gossipy_tpu.handlers.kmeans import exact_match, greedy_match
+        rng = np.random.default_rng(1)
+        excess = []
+        for _ in range(50):
+            cost = rng.uniform(0.1, 1.0, size=(5, 5))
+            g = np.asarray(greedy_match(jnp.asarray(cost, jnp.float32)))
+            assert np.array_equal(np.sort(g), np.arange(5))  # a permutation
+            gc = self._assign_cost(cost, g)
+            ec = self._assign_cost(cost, exact_match(cost))
+            assert ec <= gc + 1e-9
+            excess.append(gc / ec - 1.0)
+        assert 0.0 < np.mean(excess) < 0.25, np.mean(excess)
+
+    def test_merge_dispatch_eager_exact_traced_greedy(self):
+        # The handler's split: an EAGER merge resolves a crafted
+        # ambiguity with the exact solver; the SAME merge under jit keeps
+        # the greedy assignment. Geometry: c1 = [0, 10], c2 = [1, -8]
+        # gives cost [[1, 8], [9, 18]] — greedy locks the cheap (0, 0)
+        # pair and pays 1 + 18 = 19; the optimum crosses over and pays
+        # 8 + 9 = 17 — so the two merge paths average DIFFERENT pairs.
+        h = KMeansHandler(k=2, dim=1, matching="hungarian")
+        st = ModelState(jnp.asarray([[0.0], [10.0]]), (), jnp.int32(1))
+        peer = PeerModel(jnp.asarray([[1.0], [-8.0]]), jnp.int32(1))
+        eager = np.asarray(h.merge(st, peer).params)
+        traced = np.asarray(jax.jit(h.merge)(st, peer).params)
+        # Exact pairs (0 with -8, 10 with 1): means [-4, 5.5].
+        np.testing.assert_allclose(eager.ravel(), [-4.0, 5.5])
+        # Greedy pairs (0 with 1, 10 with -8): means [0.5, 1].
+        np.testing.assert_allclose(traced.ravel(), [0.5, 1.0])
+        assert not np.allclose(eager, traced)
+
+
 class TestMixedPrecision:
     def test_bf16_compute_learns_params_stay_f32(self, key):
         import optax
