@@ -63,7 +63,7 @@ def probe_backend_alive(timeout: float = 150.0) -> tuple[bool, str]:
         return False, (f"jax backend init still hung after {timeout:.0f}s "
                        "in a probe subprocess")
     if proc.returncode != 0:
-        return False, (f"jax backend failed to initialize in the probe "
+        return False, ("jax backend failed to initialize in the probe "
                        f"subprocess (rc={proc.returncode}); child stderr:\n"
                        + proc.stderr[-2000:])
     return True, ""

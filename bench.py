@@ -281,7 +281,7 @@ def bench_ours(X, y) -> float:
         print(f"[bench] sentinels on: {n_rounds} rounds in {elapsed_s:.2f}s "
               f"({n_rounds / elapsed_s:.1f} r/s; overhead "
               f"{SENTINEL_INFO['sentinels_overhead_frac']:.1%} vs "
-              f"sentinels off)", file=sys.stderr)
+              "sentinels off)", file=sys.stderr)
     except Exception as e:  # the A/B must not kill the main measurement
         print(f"[bench] sentinels A/B failed ({e!r})", file=sys.stderr)
     try:
@@ -1043,7 +1043,7 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
             err = repr(e)[:200]
     print(f"[fused-regime] CNN clique-{n}: plain {plain_ms:.1f} ms/round, "
           f"fused {fused_ms if fused_ms is None else round(fused_ms, 1)} "
-          f"ms/round" + (f" (error: {err})" if err else ""), file=sys.stderr)
+          "ms/round" + (f" (error: {err})" if err else ""), file=sys.stderr)
     speedup = (plain_ms / fused_ms) if fused_ms else None
     emit({
         "metric": "fused_merge_speedup_cnn_clique",
@@ -1090,7 +1090,7 @@ def _poll_budget(deadline: float) -> float:
             raise ValueError(raw)
         return val
     except ValueError:
-        print(f"[bench] ignoring malformed GOSSIPY_TPU_BENCH_PROBE_POLL="
+        print("[bench] ignoring malformed GOSSIPY_TPU_BENCH_PROBE_POLL="
               f"{raw!r}; using deadline/2", file=sys.stderr)
         return deadline / 2.0
 
@@ -1112,7 +1112,7 @@ def _backend_alive_with_poll(deadline: float) -> bool:
         remaining = budget - (time.monotonic() - start)
         if remaining <= 0:
             if budget > 0:
-                print(f"[bench] backend still unreachable after "
+                print("[bench] backend still unreachable after "
                       f"{budget:.0f}s of polling ({attempt} probes) — "
                       "degrading", file=sys.stderr)
             return False
@@ -1136,7 +1136,7 @@ def _deadline_override(default: float) -> float:
     try:
         return float(raw)
     except ValueError:
-        print(f"[bench] ignoring malformed GOSSIPY_TPU_BENCH_DEADLINE="
+        print("[bench] ignoring malformed GOSSIPY_TPU_BENCH_DEADLINE="
               f"{raw!r}; using {default:.0f}", file=sys.stderr)
         return default
 
@@ -1234,13 +1234,13 @@ def _run_with_watchdog(deadline: float = 1500.0) -> None:
             print("[bench] accelerator run emitted its row but wedged "
                   "before exiting — keeping the measurement", file=sys.stderr)
             sys.exit(0)
-        print(f"[bench] accelerator run wedged: no result after "
+        print("[bench] accelerator run wedged: no result after "
               f"{deadline:.0f}s (probe had succeeded) — killed it, "
               "degrading", file=sys.stderr)
         _degrade_to_cpu("wedged_after_probe")  # does not return
     if rc != 0:
         if emitted:
-            print(f"[bench] accelerator run emitted its row but exited "
+            print("[bench] accelerator run emitted its row but exited "
                   f"rc={rc} (teardown failure) — keeping the measurement",
                   file=sys.stderr)
             sys.exit(0)

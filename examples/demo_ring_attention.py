@@ -44,7 +44,7 @@ def main():
         # Re-exec onto a virtual CPU mesh (same XLA partitioner and
         # collectives as real chips) — the pattern __graft_entry__ uses.
         if os.environ.get("_GOSSIPY_TPU_DEMO_CHILD") == "1":
-            sys.exit(f"virtual mesh provisioning failed: "
+            sys.exit("virtual mesh provisioning failed: "
                      f"{len(jax.devices())} devices")
         import subprocess
 

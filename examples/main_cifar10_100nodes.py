@@ -19,7 +19,6 @@ from __future__ import annotations
 import time
 
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from _common import add_chaos_flag, add_probes_flag, add_sentinels_flag, \
@@ -115,7 +114,7 @@ def main():
     elapsed = time.perf_counter() - t0  # includes the one-time round compile
     print(f"[cifar10-100nodes] {args.rounds} rounds in {elapsed:.1f}s "
           f"({args.rounds / elapsed:.2f} r/s, first run includes compile; "
-          f"re-runs hit the persistent cache)")
+          "re-runs hit the persistent cache)")
     finish(report, args, local=False)
 
 

@@ -1,0 +1,47 @@
+"""Static-analysis layer for the jitted gossip engine.
+
+Two halves, both repo-specific (docs/analysis.md):
+
+- :mod:`~gossipy_tpu.analysis.tracelint` — an AST linter (stdlib ``ast``,
+  no new dependencies) tuned to this codebase's real bug classes: host-side
+  coercion or branching on traced values inside functions reachable from
+  the engine's ``jax.jit`` / ``lax.scan`` / ``fori_loop`` bodies, silent
+  ``np.*``/``math.*`` constant folding in traced regions, non-shape-static
+  slicing, use-after-donate of donated state buffers, and the
+  registry-completeness cross-checks (report field registry, JSONL schema
+  tolerance).  ``python -m gossipy_tpu.analysis`` runs it; a committed
+  ``analysis/baseline.json`` waives pre-existing findings so CI fails only
+  on NEW violations; ``# tracelint: disable=<rule>`` suppresses a line.
+
+- :mod:`~gossipy_tpu.analysis.hlo` — canonicalized StableHLO fingerprints
+  for the engine's round program.  ``assert_identical_hlo`` is the shared
+  helper behind every "feature off traces the identical program" test, and
+  ``scripts/hlo_gate.py`` drives the full feature-flag matrix against the
+  committed golden manifest (``analysis/hlo_golden.json``).
+
+The linter half imports only the stdlib so it stays fast and usable from
+hooks; the HLO half imports jax lazily on first use.
+"""
+
+from .tracelint import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    baseline_from_findings,
+    filter_baselined,
+    load_baseline,
+    run_tracelint,
+)
+
+
+def __getattr__(name):
+    # HLO helpers pull in jax + the engine; keep them lazy so pure-lint
+    # consumers (pre-commit hooks, the CI lint job) never pay that import.
+    _hlo_names = (
+        "canonicalize_hlo", "hlo_fingerprint", "fingerprint_text",
+        "lower_text", "compiled_text", "first_divergence",
+        "assert_identical_hlo", "gate_cases",
+    )
+    if name in _hlo_names:
+        from . import hlo
+        return getattr(hlo, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

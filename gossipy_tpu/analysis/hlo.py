@@ -1,0 +1,219 @@
+"""HLO-stability helpers: canonicalized StableHLO fingerprints + the gate
+matrix.
+
+Two fragile invariants hold this codebase together (docs/analysis.md):
+
+1. **Opt-in features are HLO-neutral when off** — ``probes=None``,
+   ``sentinels=None`` and ``chaos=None`` must trace the byte-identical
+   round program.  :func:`assert_identical_hlo` is the one shared helper
+   behind every such test (previously four ad-hoc copies in
+   tests/test_probes.py, test_health.py ×2, test_chaos.py).
+
+2. **The round program only changes on purpose** — ``scripts/hlo_gate.py``
+   fingerprints the program across the feature-flag grid (probes /
+   sentinels / chaos × on/off, history dtypes, All2All formulations) and
+   compares against the committed golden manifest
+   (``analysis/hlo_golden.json``).  Hashes are only compared when the
+   recorded jax version/backend match the current process (HLO text is not
+   stable across jax releases); the identity *pairs* are enforced
+   unconditionally.
+
+Canonicalization keeps the comparison byte-meaningful across hosts:
+location metadata and blank lines are stripped, whitespace normalized —
+but NOTHING structural is erased, so any real program change (a new op, a
+changed layout, a donation difference) moves the fingerprint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Optional
+
+_LOC_RE = re.compile(r'\s*loc\((?:[^()"]|"[^"]*")*\)')
+_HASH_LEN = 16
+
+
+def canonicalize_hlo(text: str) -> str:
+    """Normalize lowered StableHLO text for fingerprinting: drop location
+    metadata (absolute paths differ across hosts) and surrounding
+    whitespace, keep every instruction."""
+    lines = []
+    for line in text.splitlines():
+        if line.lstrip().startswith("#loc"):
+            continue
+        line = _LOC_RE.sub("", line).rstrip()
+        if line.strip():
+            lines.append(line.strip())
+    return "\n".join(lines)
+
+
+def fingerprint_text(text: str) -> str:
+    """Short stable hash of canonicalized HLO text."""
+    canon = canonicalize_hlo(text)
+    return hashlib.sha256(canon.encode()).hexdigest()[:_HASH_LEN]
+
+
+def lower_text(sim, state=None, key=None, n_rounds: int = 2) -> str:
+    """The simulator's ``n_rounds`` round-scan program as StableHLO text
+    (AOT-lowered — nothing is compiled or executed)."""
+    import jax
+    key = key if key is not None else jax.random.PRNGKey(0)
+    if state is None:
+        state = sim.init_nodes(key)
+    return sim.lower_start(state, n_rounds=n_rounds, key=key).as_text()
+
+
+def compiled_text(sim, state=None, key=None, n_rounds: int = 2) -> str:
+    """The POST-compilation HLO text of the round program (named scopes
+    and fusion decisions live here; the StableHLO from :func:`lower_text`
+    predates them). Compiles for real — costlier than lowering."""
+    import jax
+    key = key if key is not None else jax.random.PRNGKey(0)
+    if state is None:
+        state = sim.init_nodes(key)
+    return sim.lower_start(state, n_rounds=n_rounds,
+                           key=key).compile().as_text()
+
+
+def hlo_fingerprint(sim, state=None, key=None,
+                    n_rounds: int = 2) -> tuple[str, str]:
+    """``(fingerprint, canonical_text)`` of the simulator's round program."""
+    text = lower_text(sim, state, key, n_rounds)
+    canon = canonicalize_hlo(text)
+    return hashlib.sha256(canon.encode()).hexdigest()[:_HASH_LEN], canon
+
+
+def first_divergence(text_a: str, text_b: str,
+                     label_a: str = "a", label_b: str = "b"
+                     ) -> Optional[dict]:
+    """First divergent instruction between two canonicalized HLO programs.
+
+    Returns ``None`` when identical, else a dict naming the 1-based
+    canonical instruction index and both sides' instruction text (one side
+    is ``"<end of program>"`` on a pure length divergence).
+    """
+    a, b = canonicalize_hlo(text_a).split("\n"), \
+        canonicalize_hlo(text_b).split("\n")
+    for i, (la, lb) in enumerate(zip(a, b)):
+        if la != lb:
+            return {"instruction": i + 1, label_a: la, label_b: lb,
+                    f"{label_a}_total": len(a), f"{label_b}_total": len(b)}
+    if len(a) != len(b):
+        i = min(len(a), len(b))
+        return {"instruction": i + 1,
+                label_a: a[i] if i < len(a) else "<end of program>",
+                label_b: b[i] if i < len(b) else "<end of program>",
+                f"{label_a}_total": len(a), f"{label_b}_total": len(b)}
+    return None
+
+
+def assert_identical_hlo(sim_a, sim_b, state=None, key=None,
+                         n_rounds: int = 2, label: str = "") -> None:
+    """Assert two simulators trace the SAME round program, naming the
+    first divergent instruction on failure.  The shared backbone of every
+    "feature off is HLO-neutral" test."""
+    import jax
+    key = key if key is not None else jax.random.PRNGKey(0)
+    if state is None:
+        state = sim_a.init_nodes(key)
+    ta = lower_text(sim_a, state, key, n_rounds)
+    tb = lower_text(sim_b, state, key, n_rounds)
+    if canonicalize_hlo(ta) == canonicalize_hlo(tb):
+        return
+    div = first_divergence(ta, tb, "sim_a", "sim_b")
+    raise AssertionError(
+        f"HLO divergence{f' ({label})' if label else ''} at canonical "
+        f"instruction {div['instruction']}:\n"
+        f"  sim_a: {div['sim_a']}\n"
+        f"  sim_b: {div['sim_b']}\n"
+        f"  ({div['sim_a_total']} vs {div['sim_b_total']} instructions)")
+
+
+# ---------------------------------------------------------------------------
+# The gate matrix (scripts/hlo_gate.py drives this)
+
+_N, _D = 16, 6
+
+
+def _make_data(seed=0, n_samples=320):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_samples, _D)).astype(np.float32)
+    y = (X @ rng.normal(size=_D) > 0).astype(np.int64)
+    return X, y
+
+
+def _make_sim(cls=None, *, all2all=False, sparse_mix_form=None, **kwargs):
+    import optax
+
+    from ..core import (AntiEntropyProtocol, CreateModelMode,
+                        SparseTopology, Topology, uniform_mixing)
+    from ..data import ClassificationDataHandler, DataDispatcher
+    from ..handlers import SGDHandler, losses
+    from ..models import LogisticRegression
+    from ..simulation import All2AllGossipSimulator, GossipSimulator
+
+    X, y = _make_data()
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    disp = DataDispatcher(dh, n=_N, eval_on_user=False)
+    topo = Topology.random_regular(_N, 4, seed=3)
+    handler = SGDHandler(model=LogisticRegression(_D, 2),
+                         loss=losses.cross_entropy,
+                         optimizer=optax.sgd(0.1), local_epochs=1,
+                         batch_size=8, n_classes=2, input_shape=(_D,),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    if all2all:
+        if sparse_mix_form is not None:
+            topo = SparseTopology.random_regular(_N, 4, seed=3)
+            kwargs["sparse_mix_form"] = sparse_mix_form
+        mixing = uniform_mixing(topo)
+        return All2AllGossipSimulator(handler, topo, disp.stacked(),
+                                      delta=20, mixing=mixing, **kwargs)
+    cls = cls or GossipSimulator
+    return cls(handler, topo, disp.stacked(), delta=20,
+               protocol=AntiEntropyProtocol.PUSH, **kwargs)
+
+
+def _small_chaos():
+    from ..simulation import ChaosConfig, PartitionEpisode
+    half = tuple(range(_N // 2)), tuple(range(_N // 2, _N))
+    return ChaosConfig(partitions=(PartitionEpisode(
+        components=half, start=1, stop=3),), horizon=4)
+
+
+def gate_cases() -> dict:
+    """The full gate matrix.
+
+    Returns ``{"identity": [(name, build_default, build_off)],
+    "fingerprint": [(name, build)]}`` — identity pairs must trace the
+    byte-identical program; fingerprint cases hash against the golden
+    manifest.  Builders are zero-arg callables so the driver controls
+    construction cost and ordering.
+    """
+    identity = [
+        ("engine/probes-off",
+         lambda: _make_sim(), lambda: _make_sim(probes=None)),
+        ("engine/sentinels-off",
+         lambda: _make_sim(), lambda: _make_sim(sentinels=None)),
+        ("engine/chaos-off",
+         lambda: _make_sim(), lambda: _make_sim(chaos=None)),
+        ("all2all/sentinels-off",
+         lambda: _make_sim(all2all=True),
+         lambda: _make_sim(all2all=True, sentinels=None)),
+    ]
+    fingerprint = [
+        ("engine/base", lambda: _make_sim()),
+        ("engine/probes-on", lambda: _make_sim(probes=True)),
+        ("engine/sentinels-on", lambda: _make_sim(sentinels=True)),
+        ("engine/chaos-on", lambda: _make_sim(chaos=_small_chaos())),
+        ("engine/history-bf16",
+         lambda: _make_sim(history_dtype="bfloat16")),
+        ("engine/history-int8", lambda: _make_sim(history_dtype="int8")),
+        ("all2all/dense", lambda: _make_sim(all2all=True)),
+        ("all2all/sparse-padded",
+         lambda: _make_sim(all2all=True, sparse_mix_form="padded")),
+        ("all2all/sparse-segment",
+         lambda: _make_sim(all2all=True, sparse_mix_form="segment")),
+    ]
+    return {"identity": identity, "fingerprint": fingerprint}

@@ -1,0 +1,1230 @@
+"""tracelint: an AST linter for the jitted gossip engine's bug classes.
+
+Pure stdlib (``ast`` + ``json``) — importing this module never pulls in
+jax, so the lint runs in milliseconds from CI hooks.
+
+What it knows that a generic linter does not:
+
+- **Which functions are traced.**  Roots are functions passed to
+  ``jax.jit`` / ``lax.scan`` / ``lax.fori_loop`` / ``lax.cond`` /
+  ``lax.while_loop`` / ``jax.vmap`` (and friends), functions *returned by*
+  a factory that is itself jitted (the engine's ``_make_run`` pattern),
+  and ``@jax.jit``-decorated defs.  The call graph then propagates
+  tracedness through repo-internal calls (module functions, ``self.``
+  methods including subclass overrides — a variant's ``_round`` override
+  is as traced as the base's).  Functions handed to ``io_callback`` /
+  ``pure_callback`` / ``debug.callback`` are HOST sinks and are excluded
+  even when defined inside a traced region.
+
+- **Which values are traced.**  Inside a traced function the parameters
+  (minus ``self``/``cls``) are tainted; taint propagates through
+  assignments, ``jnp.*``/``jax.*`` call results, and any call fed a
+  tainted argument.  Shape-static reads (``x.shape``, ``x.ndim``,
+  ``x.dtype``, ``len(x)``, ``is None`` tests) deliberately do NOT taint —
+  ``int(x.shape[0])`` is fine, ``int(x[0])`` is not.
+
+Rules (ids are stable, grep-able, and the suppression currency):
+
+=================== =====================================================
+id                  fires on
+=================== =====================================================
+host-coerce         ``float()``/``int()``/``bool()`` (or ``.item()`` /
+                    ``.tolist()``) of a traced value in a traced region —
+                    a ConcretizationTypeError at best, a silently
+                    trace-time-frozen constant at worst
+host-branch         ``if``/``while``/``for``/``assert``/ternary on a
+                    traced value in a traced region (branch must be
+                    ``lax.cond``/``jnp.where``; iteration ``fori_loop``)
+np-in-trace         ``np.*``/``math.*`` called ON a traced value in a
+                    traced region: numpy silently concretizes and
+                    constant-folds the tracer
+traced-slice        a Python slice ``x[a:b]`` whose bound is traced —
+                    shapes must be static; use ``lax.dynamic_slice``
+use-after-donate    a buffer passed to a donating call
+                    (``donate_state=True`` by default on ``start``, or
+                    ``donate_argnums``) is read again afterwards — the
+                    donated input is invalidated
+registry-field      a ``probe_*``/``health_*``/``chaos_*`` per-round stat
+                    key that is missing from the report registry
+                    (``PER_ROUND_FIELDS``/``STATIC_FIELDS``) — it would
+                    silently vanish from save/load/concatenate
+schema-tolerance    ``JSONLinesReceiver.SCHEMA`` was bumped past the
+                    versions ``parse_line`` tolerates
+=================== =====================================================
+
+Suppression: append ``# tracelint: disable=<rule>[,<rule>...]`` (or
+``disable=all``) to the offending line.  Pre-existing findings live in the
+committed ``analysis/baseline.json`` (finding identity = rule + file +
+hash of the stripped source line, so baselined findings survive line-number
+drift); ``python -m gossipy_tpu.analysis`` fails only on NEW findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+ALL_RULES = {
+    "host-coerce": "host coercion (float/int/bool/.item) of a traced value",
+    "host-branch": "host control flow (if/while/for/assert) on a traced value",
+    "np-in-trace": "np.*/math.* call on a traced value (silent constant fold)",
+    "traced-slice": "Python slice with a traced bound (non-static shape)",
+    "use-after-donate": "donated buffer read after the donating call",
+    "registry-field": "per-round stat key missing from the report registry",
+    "schema-tolerance": "JSONL SCHEMA bumped past parse_line's tolerance",
+}
+
+# Call-name suffix -> positions of function-valued operands that are traced.
+# None means "every positional argument from index 0" (switch: from 1).
+_TRACING_CALLS = {
+    "jit": (0,),
+    "scan": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "switch": "tail",     # lax.switch(index, branches...) / branch list
+    "vmap": (0,),
+    "pmap": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "named_call": (0,),
+    "associative_scan": (0,),
+    "shard_map": (0,),
+    "custom_jvp": (0,),
+    "custom_vjp": (0,),
+}
+
+# Functions whose function-valued first argument runs on the HOST even when
+# the call site is traced (callbacks). Never propagate tracedness into them.
+_HOST_SINKS = {"io_callback", "pure_callback", "callback", "debug_callback"}
+
+# Attribute reads that are shape-static on a tracer (do not carry taint).
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "weak_type", "itemsize"}
+
+# Generic container/str method names: an ``obj.m(...)`` call with one of
+# these names never resolves to a repo method (keeps ``stats.update(...)``
+# from tainting every handler ``update``).
+_METHOD_DENYLIST = {
+    "append", "add", "extend", "insert", "pop", "remove", "clear", "copy",
+    "get", "items", "keys", "values", "setdefault", "update", "split",
+    "join", "strip", "startswith", "endswith", "format", "encode", "decode",
+    "write", "read", "close", "flush", "sum", "mean", "max", "min", "all",
+    "any", "astype", "reshape", "tolist", "item", "index", "count", "sort",
+    "total",
+}
+
+_STAT_KEY_RE = re.compile(r"^(probe|health|chaos)_[a-z0-9_]+$")
+_SUPPRESS_RE = re.compile(r"#\s*tracelint:\s*disable=([a-z\-,\s]+|all)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*tracelint:\s*disable-file=([a-z\-,\s]+|all)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    snippet: str       # stripped source line (finding identity basis)
+
+    @property
+    def key(self) -> str:
+        digest = hashlib.sha1(self.snippet.encode()).hexdigest()[:12]
+        return f"{self.rule}|{self.path}|{digest}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet, "key": self.key}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Module model
+
+
+@dataclass
+class _Func:
+    module: str                       # relpath
+    qualname: str
+    node: ast.AST                     # FunctionDef / AsyncFunctionDef / Lambda
+    class_name: Optional[str]
+    parent: Optional["_Func"]         # lexically enclosing function
+
+    @property
+    def uid(self) -> tuple:
+        return (self.module, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+class _Module:
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        # Qualnames are NOT unique (e.g. the same nested name defined in
+        # both branches of an if) — every index maps to a list.
+        self.funcs: dict[str, list] = {}
+        self.by_node: dict[int, _Func] = {}
+        self.classes: dict[str, dict] = {}   # name -> {bases, methods}
+        self.imports: dict[str, str] = {}    # local name -> dotted module
+        self.from_imports: dict[str, tuple] = {}  # name -> (module, orig)
+
+    def dotted(self) -> str:
+        return self.relpath[:-3].replace("/", ".")
+
+
+def _resolve_relative(module_dotted: str, node: ast.ImportFrom) -> str:
+    if node.level == 0:
+        return node.module or ""
+    parts = module_dotted.split(".")
+    base = parts[: len(parts) - node.level]
+    if node.module:
+        base.append(node.module)
+    return ".".join(base)
+
+
+class _Indexer(ast.NodeVisitor):
+    """One pass per module: functions (with lexical parents), classes,
+    imports."""
+
+    def __init__(self, mod: _Module):
+        self.mod = mod
+        self.class_stack: list[str] = []
+        self.func_stack: list[_Func] = []
+
+    def _qual(self, name: str) -> str:
+        if self.func_stack:
+            return self.func_stack[-1].qualname + ".<locals>." + name
+        if self.class_stack:
+            return ".".join(self.class_stack) + "." + name
+        return name
+
+    def _visit_func(self, node):
+        fn = _Func(self.mod.relpath, self._qual(node.name), node,
+                   self.class_stack[-1] if self.class_stack
+                   and not self.func_stack else None,
+                   self.func_stack[-1] if self.func_stack else None)
+        self.mod.funcs.setdefault(fn.qualname, []).append(fn)
+        self.mod.by_node[id(node)] = fn
+        if fn.class_name is not None:
+            self.mod.classes[fn.class_name]["methods"].setdefault(
+                node.name, []).append(fn)
+        self.func_stack.append(fn)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        bases = [b.id if isinstance(b, ast.Name) else b.attr
+                 for b in node.bases
+                 if isinstance(b, (ast.Name, ast.Attribute))]
+        self.mod.classes.setdefault(node.name,
+                                    {"bases": bases, "methods": {}})
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            self.mod.imports[alias.asname or alias.name.split(".")[0]] = \
+                alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        src = _resolve_relative(self.mod.dotted(), node)
+        for alias in node.names:
+            self.mod.from_imports[alias.asname or alias.name] = \
+                (src, alias.name)
+
+
+# ---------------------------------------------------------------------------
+# Repo model: all modules, cross-module resolution, traced-set propagation
+
+
+class _Repo:
+    def __init__(self, modules: list[_Module]):
+        self.modules = {m.relpath: m for m in modules}
+        self.by_dotted = {m.dotted(): m for m in modules}
+        self.method_index: dict[str, list[_Func]] = {}
+        self.subclasses: dict[str, set] = {}   # class name -> subclass names
+        self.class_home: dict[str, _Module] = {}
+        for m in modules:
+            for cname, info in m.classes.items():
+                self.class_home.setdefault(cname, m)
+                for fns in info["methods"].values():
+                    for fn in fns:
+                        self.method_index.setdefault(fn.name,
+                                                     []).append(fn)
+        for m in modules:
+            for cname, info in m.classes.items():
+                for b in info["bases"]:
+                    self.subclasses.setdefault(b, set()).add(cname)
+
+    def transitive_subclasses(self, cname: str) -> set:
+        out, todo = set(), [cname]
+        while todo:
+            c = todo.pop()
+            for s in self.subclasses.get(c, ()):
+                if s not in out:
+                    out.add(s)
+                    todo.append(s)
+        return out
+
+    def class_chain(self, cname: str) -> list:
+        """cname + its repo base classes, transitively (MRO-ish order)."""
+        out, todo = [], [cname]
+        while todo:
+            c = todo.pop(0)
+            if c in out:
+                continue
+            out.append(c)
+            home = self.class_home.get(c)
+            if home is not None:
+                todo.extend(home.classes[c]["bases"])
+        return out
+
+    def find_method(self, cname: str, mname: str) -> list:
+        """Resolve ``self.mname`` inside class ``cname``: the defining class
+        up the chain, PLUS every subclass override below ``cname`` (a traced
+        base method means the variant overrides trace too)."""
+        hits = []
+        for c in self.class_chain(cname):
+            home = self.class_home.get(c)
+            if home is not None and mname in home.classes[c]["methods"]:
+                hits.extend(home.classes[c]["methods"][mname])
+                break
+        for sub in self.transitive_subclasses(cname):
+            home = self.class_home.get(sub)
+            if home is not None and mname in home.classes[sub]["methods"]:
+                hits.extend(home.classes[sub]["methods"][mname])
+        return hits
+
+    def module_func(self, mod: _Module, name: str,
+                    context: Optional[_Func]) -> list:
+        # Lexical chain: nested defs of the context (and its ancestors),
+        # then module level, then repo-internal imports.
+        seen = context
+        while seen is not None:
+            q = seen.qualname + ".<locals>." + name
+            if q in mod.funcs:
+                return list(mod.funcs[q])
+            seen = seen.parent
+        if name in mod.funcs:
+            return list(mod.funcs[name])
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            target = self.by_dotted.get(src)
+            if target is not None and orig in target.funcs:
+                return list(target.funcs[orig])
+            # ``from .x import SomeClass`` — methods resolve via attr calls.
+        return []
+
+    def resolve_call(self, mod: _Module, call: ast.Call,
+                     context: Optional[_Func]) -> list:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.module_func(mod, f.id, context)
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                root = f.value.id
+                if root in ("self", "cls"):
+                    cname = _enclosing_class(context)
+                    if cname is not None:
+                        return self.find_method(cname, f.attr)
+                    return []
+                if root in mod.imports:
+                    # An imported module: resolve inside it when it is a
+                    # repo module, NEVER fall through to the generic method
+                    # index (jax.random.uniform must not resolve to some
+                    # repo method named ``uniform``).
+                    target = self.by_dotted.get(mod.imports[root])
+                    if target is not None and f.attr in target.funcs:
+                        return list(target.funcs[f.attr])
+                    return []
+                if root in mod.from_imports:  # imported repo class/submodule
+                    src, orig = mod.from_imports[root]
+                    target = self.by_dotted.get(src + "." + orig) \
+                        or self.by_dotted.get(src)
+                    if target is not None:
+                        if f.attr in target.funcs:
+                            return list(target.funcs[f.attr])
+                        if orig in target.classes:
+                            return self.find_method(orig, f.attr)
+                    return []
+            elif not isinstance(f.value, (ast.Attribute, ast.Call)):
+                return []
+            else:
+                # Nested chain (a.b.m / f().m): external when it roots at
+                # an imported non-repo module (jax.random.uniform).
+                root = f.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in mod.imports \
+                        and mod.imports[root.id] not in self.by_dotted:
+                    return []
+            # obj.m(...): any repo method named m (heuristic; the generic
+            # names in the denylist stay host-side).
+            if f.attr not in _METHOD_DENYLIST:
+                return self.method_index.get(f.attr, [])
+        return []
+
+
+def _enclosing_class(fn: Optional[_Func]) -> Optional[str]:
+    while fn is not None:
+        if fn.class_name is not None:
+            return fn.class_name
+        fn = fn.parent
+    return None
+
+
+def _callee_suffix(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_jax_chain(expr: ast.AST) -> bool:
+    """Does this callee chain plausibly root at jax/lax/jnp?"""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return isinstance(expr, ast.Name) and expr.id in (
+        "jax", "lax", "jnp", "pjit", "xla")
+
+
+def _static_argnames(call_kw: list) -> frozenset:
+    """Parameter names declared static via ``static_argnames`` (argnums
+    resolve to names later, at lint time, via the function's arg list)."""
+    names = []
+    for k in call_kw:
+        if k.arg == "static_argnames":
+            vals = k.value.elts if isinstance(k.value,
+                                              (ast.Tuple, ast.List)) \
+                else [k.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.append(v.value)
+    return frozenset(names)
+
+
+def _static_argnums(call_kw: list) -> frozenset:
+    nums = []
+    for k in call_kw:
+        if k.arg == "static_argnums":
+            vals = k.value.elts if isinstance(k.value,
+                                              (ast.Tuple, ast.List)) \
+                else [k.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.append(v.value)
+    return frozenset(nums)
+
+
+class _RootFinder(ast.NodeVisitor):
+    """Find traced-region roots + host-sink functions in one module."""
+
+    def __init__(self, mod: _Module, repo: _Repo):
+        self.mod = mod
+        self.repo = repo
+        self.roots: list[tuple] = []          # (_Func, static_names, nums)
+        self.lambda_roots: list[tuple] = []   # (Lambda node, context)
+        self.host_sink_nodes: set = set()     # id() of def/lambda nodes
+        self.factory_jitted: list[_Func] = []
+        self.func_stack: list[_Func] = []
+
+    def _context(self) -> Optional[_Func]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    def _visit_func(self, node):
+        fn = self.mod.by_node[id(node)]
+        self.func_stack.append(fn)
+        # @jax.jit / @jit / @partial(jax.jit, ...) decorated defs are roots.
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            kw = dec.keywords if isinstance(dec, ast.Call) else []
+            if isinstance(dec, ast.Call) and dec.args \
+                    and _callee_suffix(dec) == "partial":
+                target = dec.args[0]
+            if _callee_suffix_expr(target) in ("jit", "vmap", "pmap",
+                                               "checkpoint", "remat"):
+                self.roots.append((fn, _static_argnames(kw),
+                                   _static_argnums(kw)))
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _mark_arg(self, arg: ast.AST, static_names=frozenset(),
+                  static_nums=frozenset()):
+        ctx = self._context()
+        if isinstance(arg, ast.Lambda):
+            self.lambda_roots.append((arg, ctx))
+        elif isinstance(arg, ast.Name):
+            for fn in self.repo.module_func(self.mod, arg.id, ctx):
+                self.roots.append((fn, static_names, static_nums))
+        elif isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and \
+                arg.value.id in ("self", "cls"):
+            cname = _enclosing_class(ctx)
+            if cname is not None:
+                for fn in self.repo.find_method(cname, arg.attr):
+                    self.roots.append((fn, static_names, static_nums))
+        elif isinstance(arg, ast.Call):
+            # Factory pattern: jax.jit(self._make_run(...)) — the traced
+            # function is whatever the factory RETURNS.
+            for fac in self.repo.resolve_call(self.mod, arg, ctx):
+                self.factory_jitted.append(fac)
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            for el in arg.elts:
+                self._mark_arg(el, static_names, static_nums)
+
+    def visit_Call(self, node: ast.Call):
+        suffix = _callee_suffix(node)
+        if suffix in _HOST_SINKS and node.args:
+            sink = node.args[0]
+            if isinstance(sink, (ast.Lambda,)):
+                self.host_sink_nodes.add(id(sink))
+            elif isinstance(sink, ast.Name):
+                for fn in self.repo.module_func(self.mod, sink.id,
+                                                self._context()):
+                    self.host_sink_nodes.add(id(fn.node))
+        elif suffix in _TRACING_CALLS and (
+                _is_jax_chain(node.func) or isinstance(node.func, ast.Name)):
+            spec = _TRACING_CALLS[suffix]
+            statics = _static_argnames(node.keywords)
+            nums = _static_argnums(node.keywords)
+            if spec == "tail":
+                for arg in node.args[1:]:
+                    self._mark_arg(arg, statics, nums)
+            else:
+                for pos in spec:
+                    if pos < len(node.args):
+                        self._mark_arg(node.args[pos], statics, nums)
+        self.generic_visit(node)
+
+
+def _callee_suffix_expr(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _own_nodes(fn_node: ast.AST):
+    """Walk a function's OWN code: descend lambdas (they execute inline
+    during trace) but never nested ``def``s — those are separate regions
+    that become traced only via the call graph (an io_callback body defined
+    inside a traced method stays host-side)."""
+    todo = list(ast.iter_child_nodes(fn_node))
+    while todo:
+        node = todo.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            todo.extend(ast.iter_child_nodes(node))
+
+
+def _returned_nested_defs(fn: _Func, mod: _Module) -> list:
+    """Nested defs a factory function returns (by name)."""
+    out = []
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            q = fn.qualname + ".<locals>." + node.value.id
+            if q in mod.funcs:
+                out.extend(mod.funcs[q])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Taint-based rules inside one traced function
+
+
+_STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config", "axis_name",
+                       "mesh"}
+_STATIC_ANNOTATIONS = {"bool", "int", "float", "str", "bytes", "dict",
+                       "list", "tuple", "set", "Mesh", "Topology",
+                       "SparseTopology"}
+
+
+def _param_is_static(a: ast.arg) -> bool:
+    """Parameters that are static-by-contract in a traced function: config
+    objects and python-scalar-annotated knobs resolve at trace time."""
+    if a.arg in _STATIC_PARAM_NAMES:
+        return True
+    if a.annotation is None:
+        return False
+    names = {n.id for n in ast.walk(a.annotation)
+             if isinstance(n, ast.Name)}
+    names |= {n.attr for n in ast.walk(a.annotation)
+              if isinstance(n, ast.Attribute)}
+    for n in ast.walk(a.annotation):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            names.add(n.value)
+    return any(n in _STATIC_ANNOTATIONS or n.endswith("Config")
+               for n in names)
+
+
+class _TaintLinter(ast.NodeVisitor):
+    def __init__(self, mod: _Module, fn_node: ast.AST,
+                 host_sinks: set, findings: list,
+                 static_names=frozenset(), static_nums=frozenset()):
+        self.mod = mod
+        self.findings = findings
+        self.host_sinks = host_sinks
+        self.tainted: set = set()
+        self.containers: set = set()   # host containers of traced values
+        args = fn_node.args
+        ordered = args.posonlyargs + args.args
+        by_num = {i: a.arg for i, a in enumerate(ordered)}
+        static = set(static_names) | {by_num[i] for i in static_nums
+                                      if i in by_num}
+        for a in (ordered + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg not in static and not _param_is_static(a):
+                self.tainted.add(a.arg)
+        body = fn_node.body
+        self._nodes = body if isinstance(body, list) else [body]
+
+    def run(self):
+        for stmt in self._nodes:
+            self.visit(stmt)
+
+    # -- taint query ------------------------------------------------------
+
+    def _is_tainted(self, expr: ast.AST) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self._is_tainted(expr.value)
+        if isinstance(expr, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return False  # identity tests are static on tracers
+            return self._is_tainted(expr.left) or \
+                any(self._is_tainted(c) for c in expr.comparators)
+        if isinstance(expr, ast.Call):
+            suffix = _callee_suffix(expr)
+            if suffix in ("len", "isinstance", "getattr", "hasattr",
+                          "type", "id", "repr", "str"):
+                return False
+            if _is_jax_chain(expr.func):
+                return True
+            return self._is_tainted(expr.func) or \
+                any(self._is_tainted(a) for a in expr.args) or \
+                any(self._is_tainted(k.value) for k in expr.keywords)
+        if isinstance(expr, (ast.BinOp,)):
+            return self._is_tainted(expr.left) or self._is_tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self._is_tainted(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self._is_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.IfExp):
+            return self._is_tainted(expr.body) or \
+                self._is_tainted(expr.orelse)
+        if isinstance(expr, ast.Subscript):
+            return self._is_tainted(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return any(self._is_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.Starred):
+            return self._is_tainted(expr.value)
+        if isinstance(expr, ast.NamedExpr):
+            return self._is_tainted(expr.value)
+        return False
+
+    def _taint_target(self, target: ast.AST):
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._taint_target(el)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+
+    def _untaint_target(self, target: ast.AST):
+        if isinstance(target, ast.Name):
+            self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._untaint_target(el)
+
+    # -- findings ---------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 1)
+        text = self.mod.lines[line - 1].strip() \
+            if 0 < line <= len(self.mod.lines) else ""
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.relpath, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            snippet=text))
+
+    # -- statements (visited in order; one flat scope) --------------------
+
+    def _is_container_expr(self, expr) -> bool:
+        return (isinstance(expr, ast.Call)
+                and _callee_suffix(expr) in self._CONTAINER_ITERS) or \
+            (isinstance(expr, ast.Name) and expr.id in self.containers) or \
+            isinstance(expr, (ast.Tuple, ast.List, ast.ListComp))
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        if self._is_tainted(node.value):
+            for t in node.targets:
+                self._taint_target(t)
+                if self._is_container_expr(node.value) and \
+                        isinstance(t, ast.Name):
+                    self.containers.add(t.id)
+        else:
+            for t in node.targets:
+                self._untaint_target(t)
+                if isinstance(t, ast.Name):
+                    self.containers.discard(t.id)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self.visit(node.value)
+            if self._is_tainted(node.value):
+                self._taint_target(node.target)
+            else:
+                self._untaint_target(node.target)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        if self._is_tainted(node.value):
+            self._taint_target(node.target)
+
+    def visit_NamedExpr(self, node: ast.NamedExpr):
+        self.visit(node.value)
+        if self._is_tainted(node.value):
+            self._taint_target(node.target)
+
+    def visit_If(self, node: ast.If):
+        if self._is_tainted(node.test):
+            self._emit("host-branch", node,
+                       "`if` on a traced value — the branch is resolved at "
+                       "trace time (use lax.cond / jnp.where)")
+        self.visit(node.test)
+        for s in node.body + node.orelse:
+            self.visit(s)
+
+    def visit_While(self, node: ast.While):
+        if self._is_tainted(node.test):
+            self._emit("host-branch", node,
+                       "`while` on a traced value (use lax.while_loop)")
+        self.generic_visit(node)
+
+    # Iterating these yields a HOST container whose *elements* may be
+    # traced — the loop itself is trace-safe (pytree leaves, zips of leaf
+    # lists). The loop targets inherit the taint instead.
+    _CONTAINER_ITERS = {"leaves", "tree_leaves", "tree_flatten", "flatten",
+                        "enumerate", "zip", "reversed", "sorted", "list",
+                        "tuple", "items", "keys", "values", "split"}
+
+    def visit_For(self, node: ast.For):
+        if self._is_tainted(node.iter):
+            if self._is_container_expr(node.iter):
+                self._taint_target(node.target)
+            else:
+                self._emit("host-branch", node,
+                           "`for` over a traced value — the loop unrolls "
+                           "(or fails) at trace time (use "
+                           "lax.fori_loop/scan)")
+                self._taint_target(node.target)
+        self.visit(node.iter)
+        for s in node.body + node.orelse:
+            self.visit(s)
+
+    def visit_Assert(self, node: ast.Assert):
+        if self._is_tainted(node.test):
+            self._emit("host-branch", node,
+                       "`assert` on a traced value (use "
+                       "checkify / debug.check, or assert on static shape)")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        if self._is_tainted(node.test):
+            self._emit("host-branch", node,
+                       "ternary on a traced value (use jnp.where)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        suffix = _callee_suffix(node)
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in ("float", "int", "bool") and node.args and \
+                self._is_tainted(node.args[0]):
+            self._emit("host-coerce", node,
+                       f"`{node.func.id}()` of a traced value concretizes "
+                       "the tracer (compute in-graph, coerce after the run)")
+        elif isinstance(node.func, ast.Attribute) and \
+                suffix in ("item", "tolist") and \
+                self._is_tainted(node.func.value):
+            self._emit("host-coerce", node,
+                       f"`.{suffix}()` of a traced value pulls it to host "
+                       "at trace time")
+        elif isinstance(node.func, ast.Attribute):
+            root = node.func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and \
+                    root.id in ("np", "numpy", "math") and \
+                    (any(self._is_tainted(a) for a in node.args)
+                     or any(self._is_tainted(k.value)
+                            for k in node.keywords)):
+                self._emit("np-in-trace", node,
+                           f"`{root.id}.{suffix}` on a traced value — numpy "
+                           "concretizes and silently constant-folds the "
+                           "tracer (use jnp)")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        slices = []
+        if isinstance(node.slice, ast.Slice):
+            slices = [node.slice]
+        elif isinstance(node.slice, ast.Tuple):
+            slices = [e for e in node.slice.elts
+                      if isinstance(e, ast.Slice)]
+        for sl in slices:
+            for bound in (sl.lower, sl.upper, sl.step):
+                if bound is not None and self._is_tainted(bound):
+                    self._emit("traced-slice", node,
+                               "slice bound is a traced value — result "
+                               "shape would be dynamic (use "
+                               "lax.dynamic_slice)")
+                    break
+        self.generic_visit(node)
+
+    def _skip_nested(self, node):
+        # Nested defs/lambdas get their own traced-region pass (via the
+        # call graph) or are host sinks; don't lint them with THIS scope's
+        # taint.
+        pass
+
+    visit_FunctionDef = _skip_nested
+    visit_AsyncFunctionDef = _skip_nested
+    visit_Lambda = _skip_nested
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate (host-side rule, every function)
+
+
+class _DonateLinter:
+    def __init__(self, mod: _Module, findings: list):
+        self.mod = mod
+        self.findings = findings
+
+    @staticmethod
+    def _donating_call(call: ast.Call) -> Optional[str]:
+        """The donated first-positional-arg name, if this call donates."""
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        donates = False
+        if "donate_argnums" in kw:
+            donates = True
+        if "donate_state" in kw:
+            v = kw["donate_state"]
+            donates = not (isinstance(v, ast.Constant) and v.value is False)
+        elif _callee_suffix(call) == "start" and \
+                isinstance(call.func, ast.Attribute) and call.args:
+            donates = True  # engine start() donates by default
+        # jax.jit(f, donate_argnums=...)(state, ...) — donation lands on
+        # the OUTER call's positionals.
+        if isinstance(call.func, ast.Call):
+            inner_kw = {k.arg for k in call.func.keywords}
+            if "donate_argnums" in inner_kw:
+                donates = True
+        if donates and call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        return None
+
+    def lint_function(self, fn_node: ast.AST):
+        body = getattr(fn_node, "body", None)
+        if not isinstance(body, list):
+            return
+        donated: dict[str, int] = {}   # name -> line of donating call
+
+        def names_loaded(expr) -> set:
+            return {n.id for n in ast.walk(expr)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)}
+
+        def names_stored(stmt) -> set:
+            out = set()
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, (ast.Store, ast.Del)):
+                    out.add(n.id)
+            return out
+
+        for stmt in _linear_statements(body):
+            calls = [n for n in ast.walk(stmt)
+                     if isinstance(n, ast.Call)]
+            # Reads first: a use of an already-donated buffer fires even
+            # when this statement re-donates/rebinds it.
+            used = set()
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id in donated:
+                    used.add((n.id, n.lineno, n.col_offset))
+            for name, line, col in sorted(used):
+                text = self.mod.lines[line - 1].strip() \
+                    if 0 < line <= len(self.mod.lines) else ""
+                self.findings.append(Finding(
+                    rule="use-after-donate", path=self.mod.relpath,
+                    line=line, col=col,
+                    message=f"`{name}` was donated at line "
+                            f"{donated[name]} (donate_state/donate_argnums "
+                            "invalidates the buffer); rebind the result or "
+                            "pass donate_state=False",
+                    snippet=text))
+            stored = names_stored(stmt)
+            for s in stored:
+                donated.pop(s, None)
+            for call in calls:
+                name = self._donating_call(call)
+                if name is not None and name not in stored:
+                    donated[name] = call.lineno
+
+
+def _linear_statements(body: list) -> list:
+    """Flatten a function body into a linear statement order (branches and
+    loop bodies in source order — a deliberate approximation)."""
+    out = []
+    for stmt in body:
+        out.append(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                out.extend(_linear_statements(sub))
+        for h in getattr(stmt, "handlers", []) or []:
+            out.extend(_linear_statements(h.body))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Registry rules (repo-level)
+
+
+def _literal_str_tuples(mod: _Module) -> dict[str, tuple]:
+    """Module-level ``NAME = ("a", "b", ...)`` assignments, resolving
+    ``A + B`` concatenations of previously seen names."""
+    out: dict[str, tuple] = {}
+
+    def eval_expr(expr) -> Optional[tuple]:
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            vals = []
+            for el in expr.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    vals.append(el.value)
+                else:
+                    return None
+            return tuple(vals)
+        if isinstance(expr, ast.Name):
+            return out.get(expr.id)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left, right = eval_expr(expr.left), eval_expr(expr.right)
+            if left is not None and right is not None:
+                return left + right
+        return None
+
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            val = eval_expr(node.value)
+            if val is not None:
+                out[node.targets[0].id] = val
+    return out
+
+
+def _registry_rule(modules: dict, findings: list):
+    report = modules.get("gossipy_tpu/simulation/report.py")
+    if report is None:
+        return
+    tuples = _literal_str_tuples(report)
+    registry = set(tuples.get("PER_ROUND_FIELDS", ())) | \
+        set(tuples.get("STATIC_FIELDS", ()))
+    if not registry:
+        return
+
+    def check_key(key: str, mod: _Module, node: ast.AST):
+        if _STAT_KEY_RE.match(key) and key not in registry:
+            line = getattr(node, "lineno", 1)
+            text = mod.lines[line - 1].strip() \
+                if 0 < line <= len(mod.lines) else ""
+            findings.append(Finding(
+                rule="registry-field", path=mod.relpath, line=line,
+                col=getattr(node, "col_offset", 0),
+                message=f"per-round stat key {key!r} is not in "
+                        "report.PER_ROUND_FIELDS/STATIC_FIELDS — it would "
+                        "be silently dropped by "
+                        "to_dict/from_dict/concatenate",
+                snippet=text))
+
+    for relpath, mod in modules.items():
+        if not (relpath.startswith("gossipy_tpu/simulation/")
+                or relpath.startswith("gossipy_tpu/telemetry/")):
+            continue
+        # (a) declared stat-key tuples (PROBE_STAT_KEYS & co.)
+        for name, vals in _literal_str_tuples(mod).items():
+            if name.endswith(("_KEYS", "_FIELDS")) and \
+                    relpath != "gossipy_tpu/simulation/report.py":
+                for node in mod.tree.body:
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.targets[0], ast.Name) and \
+                            node.targets[0].id == name:
+                        for key in vals:
+                            check_key(key, mod, node)
+        # (b) direct stores into the round stats dict:
+        #     stats["health_x"] = ... / extras["probe_y"] = ...
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in ("stats", "extras") and \
+                            isinstance(t.slice, ast.Constant) and \
+                            isinstance(t.slice.value, str):
+                        check_key(t.slice.value, mod, node)
+
+
+def _schema_rule(modules: dict, findings: list):
+    mod = modules.get("gossipy_tpu/simulation/events.py")
+    if mod is None:
+        return
+    schema_val, schema_node = None, None
+    tolerated = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and \
+                node.name == "JSONLinesReceiver":
+            for item in node.body:
+                if isinstance(item, ast.Assign) and \
+                        isinstance(item.targets[0], ast.Name) and \
+                        item.targets[0].id == "SCHEMA" and \
+                        isinstance(item.value, ast.Constant):
+                    schema_val, schema_node = item.value.value, item
+                if isinstance(item, ast.FunctionDef) and \
+                        item.name == "parse_line":
+                    for cmp in ast.walk(item):
+                        if isinstance(cmp, ast.Compare) and \
+                                len(cmp.ops) == 1 and \
+                                isinstance(cmp.ops[0], (ast.Lt, ast.LtE)):
+                            c = cmp.comparators[0]
+                            if isinstance(c, ast.Constant) and \
+                                    isinstance(c.value, int):
+                                bound = c.value
+                                if isinstance(cmp.ops[0], ast.LtE):
+                                    bound += 1
+                                tolerated.append(bound)
+    if schema_val is None:
+        return
+    max_tol = max(tolerated) if tolerated else 1
+    if schema_val > max_tol:
+        line = schema_node.lineno
+        findings.append(Finding(
+            rule="schema-tolerance", path=mod.relpath, line=line,
+            col=schema_node.col_offset,
+            message=f"JSONLinesReceiver.SCHEMA = {schema_val} but "
+                    f"parse_line only tolerates versions < {max_tol + 1} "
+                    f"(add an `if schema < {schema_val}:` defaulting branch "
+                    "for the new fields)",
+            snippet=mod.lines[line - 1].strip()))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+
+
+def _file_disabled(mod: _Module) -> set:
+    """Rules disabled for the whole file via a ``# tracelint:
+    disable-file=...`` pragma in the first 30 lines ({"all"} disables
+    everything)."""
+    out: set = set()
+    for line in mod.lines[:30]:
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            rules = m.group(1).strip()
+            if rules == "all":
+                return {"all"}
+            out |= {r.strip() for r in rules.split(",")}
+    return out
+
+
+def _suppressed(mod: _Module, finding: Finding) -> bool:
+    disabled = _file_disabled(mod)
+    if "all" in disabled or finding.rule in disabled:
+        return True
+    if not (0 < finding.line <= len(mod.lines)):
+        return False
+    m = _SUPPRESS_RE.search(mod.lines[finding.line - 1])
+    if not m:
+        return False
+    rules = m.group(1).strip()
+    if rules == "all":
+        return True
+    return finding.rule in {r.strip() for r in rules.split(",")}
+
+
+def run_tracelint(root, sources: Optional[dict] = None,
+                  package: str = "gossipy_tpu") -> list:
+    """Lint every ``.py`` under ``root/package``.
+
+    ``sources`` maps repo-relative posix paths to replacement text —
+    the meta-tests use it to inject violations without touching disk.
+    Returns unsuppressed findings sorted by (path, line).
+    """
+    root = Path(root)
+    texts: dict[str, str] = {}
+    for p in sorted((root / package).rglob("*.py")):
+        rel = p.relative_to(root).as_posix()
+        if "__pycache__" in rel:
+            continue
+        texts[rel] = p.read_text()
+    if sources:
+        texts.update(sources)
+
+    modules: dict[str, _Module] = {}
+    for rel, text in texts.items():
+        try:
+            mod = _Module(rel, text)
+        except SyntaxError as e:
+            raise SyntaxError(f"{rel}: {e}") from e
+        _Indexer(mod).visit(mod.tree)
+        modules[rel] = mod
+    repo = _Repo(list(modules.values()))
+
+    # Roots + host sinks, repo-wide.
+    traced: dict[int, _Func] = {}        # id(node) -> _Func
+    static_info: dict[int, tuple] = {}   # id(node) -> (names, nums)
+    lambda_regions: list[tuple] = []
+    host_sinks: set = set()
+    worklist: list[_Func] = []
+
+    def add(fn: _Func, statics=frozenset(), nums=frozenset()):
+        if id(fn.node) in host_sinks:
+            return
+        if statics or nums:
+            static_info.setdefault(id(fn.node), (statics, nums))
+        if id(fn.node) not in traced:
+            traced[id(fn.node)] = fn
+            worklist.append(fn)
+
+    finders = {}
+    for rel, mod in modules.items():
+        finder = _RootFinder(mod, repo)
+        finder.visit(mod.tree)
+        finders[rel] = finder
+        host_sinks.update(finder.host_sink_nodes)
+    for rel, finder in finders.items():
+        for fn, statics, nums in finder.roots:
+            add(fn, statics, nums)
+        for fac in finder.factory_jitted:
+            for fn in _returned_nested_defs(fac, modules[fac.module]):
+                add(fn)
+        lambda_regions.extend(
+            (modules[rel], lam) for lam, _ in finder.lambda_roots)
+
+    # Propagate tracedness through repo-internal calls. Only a function's
+    # OWN code propagates — nested defs are separate regions reached via
+    # resolve_call (so an io_callback body inside a traced method never
+    # drags its host-side helpers into the traced set).
+    while worklist:
+        fn = worklist.pop()
+        mod = modules[fn.module]
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Call):
+                for callee in repo.resolve_call(mod, node, fn):
+                    add(callee)
+
+    findings: list[Finding] = []
+    for fn in traced.values():
+        mod = modules[fn.module]
+        statics, nums = static_info.get(id(fn.node),
+                                        (frozenset(), frozenset()))
+        _TaintLinter(mod, fn.node, host_sinks, findings,
+                     static_names=statics, static_nums=nums).run()
+        # Lambdas inside a traced function execute during the trace
+        # (tree.map leaf ops, key-fold helpers) — lint them as traced
+        # regions of their own unless they are host-callback sinks.
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Lambda) and id(node) not in host_sinks:
+                lambda_regions.append((mod, node))
+    for mod, lam in lambda_regions:
+        if id(lam) not in host_sinks:
+            _TaintLinter(mod, lam, host_sinks, findings).run()
+    for rel, mod in modules.items():
+        dl = _DonateLinter(mod, findings)
+        for fns in mod.funcs.values():
+            for fn in fns:
+                if fn.parent is None:   # lint each top-level scope once
+                    dl.lint_function(fn.node)
+    _registry_rule(modules, findings)
+    _schema_rule(modules, findings)
+
+    out = [f for f in findings if not _suppressed(modules[f.path], f)]
+    # The same (rule, path, line) can fire through several traced paths
+    # (e.g. a method traced via two roots) — report it once.
+    seen, unique = set(), []
+    for f in sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        k = (f.rule, f.path, f.line, f.col)
+        if k not in seen:
+            seen.add(k)
+            unique.append(f)
+    return unique
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+
+
+def baseline_from_findings(findings: list) -> dict:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return {"version": 1, "findings": counts}
+
+
+def load_baseline(path) -> dict:
+    p = Path(path)
+    if not p.exists():
+        return {"version": 1, "findings": {}}
+    return json.loads(p.read_text())
+
+
+def filter_baselined(findings: list, baseline: dict) -> list:
+    """Findings NOT covered by the baseline (per-key occurrence budget)."""
+    budget = dict(baseline.get("findings", {}))
+    new = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            new.append(f)
+    return new

@@ -52,7 +52,7 @@ def _topology(kind: str, n: int, params: dict, backend: str, sparse: bool):
         if kw:
             # Strict like from_dict's unknown-field check: a clique takes no
             # parameters, so silently swallowing them would hide typos.
-            raise ValueError(f"topology 'clique' accepts no params, got "
+            raise ValueError("topology 'clique' accepts no params, got "
                              f"{sorted(kw)}")
         return Topology.clique(n)
 
@@ -108,7 +108,7 @@ def _model(name: str, params: dict, input_dim: int, n_classes: int):
         return models.CIFAR10Net(
             conv_impl=params.get("conv_impl", "auto"))
     raise ValueError(f"unknown model {name!r}; options: logreg, mlp, "
-                     f"perceptron, linreg, cifar10net")
+                     "perceptron, linreg, cifar10net")
 
 
 def _delay(kind: str, params: dict):

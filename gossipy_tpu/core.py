@@ -374,7 +374,7 @@ class LinearDelay(Delay):
     def max_delay(self, size: int) -> int:
         return int(self.timexunit * size) + self.overhead
 
-    def sample(self, key, shape, size):
+    def sample(self, key, shape, size: int):
         return jnp.full(shape, int(self.timexunit * size) + self.overhead,
                         dtype=jnp.int32)
 

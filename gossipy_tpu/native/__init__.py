@@ -105,7 +105,7 @@ def random_regular(n: int, k: int, seed: int = 42) -> np.ndarray:
     rc = lib.gen_random_regular(n, k, seed, adj)
     if rc == -1:
         raise ValueError(f"no {k}-regular graph on {n} nodes (n*k must be "
-                         f"even and k < n)")
+                         "even and k < n)")
     if rc != 0:
         raise RuntimeError("pairing model failed to find a simple graph")
     return adj.view(bool)  # same itemsize; zero-copy
@@ -129,7 +129,7 @@ def random_regular_edges(n: int, k: int, seed: int = 42) -> np.ndarray:
     m = lib.gen_random_regular_edges(n, k, seed, edges)
     if m == -1:
         raise ValueError(f"no {k}-regular graph on {n} nodes (n*k must be "
-                         f"even and k < n)")
+                         "even and k < n)")
     if m < 0:
         raise RuntimeError("pairing model failed to find a simple graph")
     return edges[:m]

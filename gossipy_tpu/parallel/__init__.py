@@ -111,9 +111,9 @@ def make_mesh_2d(n_hosts: int, devices_per_host: Optional[int] = None,
             if any(len({d.process_index for d in row}) != 1 for row in arr):
                 raise ValueError(
                     f"make_mesh_2d({n_hosts}, {per}): an inner-axis row "
-                    f"would straddle a process boundary (processes have "
+                    "would straddle a process boundary (processes have "
                     f"{[len(h) for h in hosts]} devices); choose "
-                    f"devices_per_host dividing the per-process count")
+                    "devices_per_host dividing the per-process count")
         else:
             # Single-process backends (CPU test mesh, one-host TPU) have
             # no host boundaries to respect — a plain reshape is exact.
@@ -174,7 +174,7 @@ def make_mesh_tp(n_node_devices: int, n_model_devices: int,
         # A device subset cannot be chosen consistently across processes
         # without leaving some process idle; require the full complement.
         raise ValueError(
-            f"multi-host TP mesh must use every attached device: "
+            "multi-host TP mesh must use every attached device: "
             f"requested {need} of {len(devs)}")
     return Mesh(_tp_device_grid(devs[:need], n_node_devices, n_model_devices),
                 axis_names)

@@ -67,14 +67,14 @@ class RunRequest:
     def __post_init__(self):
         if not self.tenant or "/" in self.tenant:
             raise ValueError(
-                f"tenant name must be a non-empty path-safe string, got "
+                "tenant name must be a non-empty path-safe string, got "
                 f"{self.tenant!r} (it names the artifact directory)")
         if self.config.simulator in UNSERVABLE_SIMULATORS:
             raise ValueError(
                 f"simulator {self.config.simulator!r} cannot be served by "
                 f"the megabatch scheduler ({', '.join(UNSERVABLE_SIMULATORS)}"
-                f" are host-phase/eager engines); run it solo via "
-                f"run_experiment()")
+                " are host-phase/eager engines); run it solo via "
+                "run_experiment()")
         if self.config.repetitions != 1:
             raise ValueError(
                 "service runs are single-seed per tenant (submit one "
@@ -93,7 +93,7 @@ class RunRequest:
         unknown = set(spec) - {"tenant", "config", "n_rounds"}
         if unknown:
             raise ValueError(f"unknown spec fields: {sorted(unknown)}; "
-                             f"valid: tenant, config, n_rounds")
+                             "valid: tenant, config, n_rounds")
         if "tenant" not in spec or "config" not in spec:
             raise ValueError("a run spec needs 'tenant' and 'config'")
         return RunRequest(
@@ -154,7 +154,7 @@ class RunQueue:
         if any(h.tenant == request.tenant for h in self._handles
                if h.status in (RunStatus.QUEUED, RunStatus.RUNNING)):
             raise ValueError(f"tenant {request.tenant!r} already has a "
-                             f"queued or running request")
+                             "queued or running request")
         handle = RunHandle(request=request)
         self._handles.append(handle)
         return handle

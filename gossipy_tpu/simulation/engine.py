@@ -566,7 +566,7 @@ class GossipSimulator(SimulationEventSender):
             # surface as a deep lax shape error at first trace.
             if int(compact_deliver) < 1:
                 raise ValueError(
-                    f"compact_deliver capacity must be >= 1, got "
+                    "compact_deliver capacity must be >= 1, got "
                     f"{compact_deliver} (use False/None to disable)")
             self._compact_cap: Optional[int] = min(int(compact_deliver),
                                                    self.n_nodes)
@@ -789,7 +789,7 @@ class GossipSimulator(SimulationEventSender):
                 f"mailbox_slots={self.K} may overflow on this topology: "
                 f"worst-case expected same-round fan-in {lam_max:.1f} gives "
                 f"~{p_over:.1%} per-node-round message loss (counted as "
-                f"'failed'). Raise mailbox_slots to silence.")
+                "'failed'). Raise mailbox_slots to silence.")
 
     def _n_eval_nodes(self) -> int:
         """How many nodes an evaluation pass materializes (the static
@@ -837,8 +837,8 @@ class GossipSimulator(SimulationEventSender):
                 f"global evaluation materializes ~[{n_eval_nodes} nodes x "
                 f"{n_samples} samples] intermediates "
                 f"(~{est_bytes / 2**30:.1f} GB) — likely OOM on one chip. "
-                f"Use sampling_eval= to evaluate a node subset and/or a "
-                f"smaller eval split.")
+                "Use sampling_eval= to evaluate a node subset and/or a "
+                "smaller eval split.")
 
     def memory_budget(self) -> dict:
         """Construction-time device-memory budget (bytes) for the big state

@@ -141,7 +141,7 @@ class ChurnProcess:
     def __post_init__(self):
         _check_window(self.start, self.stop, "churn")
         if not 0.0 <= self.keep_frac <= 1.0:
-            raise ValueError(f"keep_frac must be in [0, 1], got "
+            raise ValueError("keep_frac must be in [0, 1], got "
                              f"{self.keep_frac}")
         if self.period < 1:
             raise ValueError("churn period must be >= 1")
@@ -162,7 +162,7 @@ class FaultSpike:
     def __post_init__(self):
         _check_window(self.start, self.stop, "spike")
         if self.drop_prob is not None and not 0.0 <= self.drop_prob <= 1.0:
-            raise ValueError(f"spike drop_prob must be in [0, 1], got "
+            raise ValueError("spike drop_prob must be in [0, 1], got "
                              f"{self.drop_prob}")
         if self.delay_scale <= 0.0:
             raise ValueError("delay_scale must be > 0")
@@ -225,7 +225,7 @@ class ChaosConfig:
             return chaos
         if isinstance(chaos, dict):
             return cls.from_dict(chaos)
-        raise TypeError(f"chaos= expects None, dict or ChaosConfig; got "
+        raise TypeError("chaos= expects None, dict or ChaosConfig; got "
                         f"{type(chaos).__name__}")
 
     def to_dict(self) -> dict:

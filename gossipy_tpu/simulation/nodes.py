@@ -313,8 +313,8 @@ class PENSGossipSimulator(GossipSimulator):
             warnings.warn(
                 f"PENS n_sampled={n_sampled} exceeds the max in-degree "
                 f"({max_senders}): the sender-keyed phase-1 buffer can never "
-                f"fill, so no node will merge or train in step 1 (the "
-                f"reference has the same degeneracy, node.py:777-783). "
+                "fill, so no node will merge or train in step 1 (the "
+                "reference has the same degeneracy, node.py:777-783). "
                 f"Consider n_sampled <= {max_senders}.")
         self.n_sampled = int(n_sampled)
         self.m_top = int(m_top)

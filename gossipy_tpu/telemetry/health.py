@@ -121,7 +121,7 @@ class SentinelConfig:
                     or sentinels.saturation):
                 return None
             return sentinels
-        raise TypeError(f"sentinels= expects None, bool or SentinelConfig; "
+        raise TypeError("sentinels= expects None, bool or SentinelConfig; "
                         f"got {type(sentinels).__name__}")
 
     def to_dict(self) -> dict:
@@ -415,7 +415,7 @@ class FlightRecorder:
                 "trailing_rounds": self.trailing_rounds,
             }}).save(os.path.join(path, "manifest.json"))
         except Exception as e:  # manifest is context, not the evidence
-            warnings.warn(f"flight recorder could not collect the run "
+            warnings.warn("flight recorder could not collect the run "
                           f"manifest: {e!r}")
 
         sink = get_sink()
@@ -426,11 +426,11 @@ class FlightRecorder:
                 and not self._warned_truncated:
             self._warned_truncated = True
             warnings.warn(
-                f"flight recorder trailing window truncated: the telemetry "
+                "flight recorder trailing window truncated: the telemetry "
                 f"sink ring evicted {sink.dropped_events} events "
                 f"(maxlen {sink.maxlen}); the bundle carries "
                 f"{len(round_events)} of the requested {want} trailing "
-                f"rounds. Install a larger TelemetrySink to keep more.")
+                "rounds. Install a larger TelemetrySink to keep more.")
         with open(os.path.join(path, "events.jsonl"), "w") as fh:
             for ev in events[-max(self.trailing_rounds, 1) * 2:]:
                 fh.write(json.dumps(ev.to_dict()) + "\n")

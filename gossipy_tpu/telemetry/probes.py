@@ -79,7 +79,7 @@ class ProbeConfig:
             if not (probes.consensus or probes.staleness or probes.mixing):
                 return None
             return probes
-        raise TypeError(f"probes= expects None, bool or ProbeConfig; got "
+        raise TypeError("probes= expects None, bool or ProbeConfig; got "
                         f"{type(probes).__name__}")
 
     def to_dict(self) -> dict:

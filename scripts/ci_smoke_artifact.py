@@ -103,7 +103,7 @@ def main() -> None:
     if bundle is not None:
         # A tripped smoke run still writes every artifact, then fails
         # loudly — the workflow uploads the bundle for replay.
-        print(f"[ci-smoke] SENTINEL TRIPPED — flight-recorder bundle at "
+        print("[ci-smoke] SENTINEL TRIPPED — flight-recorder bundle at "
               f"{bundle}", file=sys.stderr)
         sys.exit(2)
 

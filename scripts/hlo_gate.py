@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""HLO-stability gate: the engine's round program across the feature grid.
+
+Consolidates the previously scattered HLO-identity checks into one matrix
+runner (gossipy_tpu/analysis/hlo.py supplies the matrix and the
+canonicalized-fingerprint helpers):
+
+1. **Identity pairs** — ``probes=None`` / ``sentinels=None`` /
+   ``chaos=None`` (engine + All2All) must trace the byte-identical
+   program as a build without the argument. Enforced unconditionally; on
+   mismatch the FIRST divergent HLO instruction is printed and written to
+   the ``--report`` JSON.
+
+2. **Golden fingerprints** — every grid case (probes/sentinels/chaos on,
+   history dtypes, All2All dense/padded/segment formulations) is hashed
+   (canonicalized StableHLO) and compared against the committed manifest
+   ``gossipy_tpu/analysis/hlo_golden.json``. HLO text is not stable
+   across jax releases, so hashes are only compared when the manifest's
+   recorded jax version AND backend match this process; otherwise the
+   comparison is skipped with a warning (the identity pairs still gate).
+   Regenerate after a deliberate program change or a jax bump with
+   ``--update-golden``.
+
+3. **Recompilation storm check** — drives a small sim for three chunked
+   ``start()`` calls and reads the jit-cache event counters
+   (``gossipy_tpu.compilation_cache_stats()``): re-driving the same
+   (shapes, rounds) program must not re-trace. A second distinct chunk
+   size is allowed one compile; anything beyond fails.
+
+Exit codes: 0 all gates green (or skipped-with-warning), 1 divergence /
+storm, 2 usage or environment error.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+GOLDEN = REPO / "gossipy_tpu" / "analysis" / "hlo_golden.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update-golden", action="store_true",
+                    help="rewrite the golden manifest from this process's "
+                         "fingerprints")
+    ap.add_argument("--golden", default=str(GOLDEN))
+    ap.add_argument("--report", default=None,
+                    help="write a JSON divergence/summary report here")
+    ap.add_argument("--skip-cache-check", action="store_true")
+    ap.add_argument("--n-rounds", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from gossipy_tpu.analysis.hlo import (
+        first_divergence,
+        gate_cases,
+        hlo_fingerprint,
+        lower_text,
+    )
+
+    t0 = time.time()
+    cases = gate_cases()
+    report: dict = {"jax": jax.__version__,
+                    "backend": jax.default_backend(),
+                    "identity": {}, "fingerprint": {}, "failures": []}
+    failed = False
+
+    print(f"[hlo_gate] jax {jax.__version__} backend "
+          f"{jax.default_backend()}; {len(cases['identity'])} identity "
+          f"pairs, {len(cases['fingerprint'])} fingerprint cases")
+
+    for name, build_a, build_b in cases["identity"]:
+        key = jax.random.PRNGKey(0)
+        sim_a, sim_b = build_a(), build_b()
+        state = sim_a.init_nodes(key)
+        ta = lower_text(sim_a, state, key, args.n_rounds)
+        tb = lower_text(sim_b, state, key, args.n_rounds)
+        div = first_divergence(ta, tb, "default", "feature_off")
+        report["identity"][name] = {"identical": div is None,
+                                    "divergence": div}
+        if div is None:
+            print(f"[hlo_gate] identity {name}: OK")
+        else:
+            failed = True
+            report["failures"].append(f"identity:{name}")
+            print(f"[hlo_gate] identity {name}: DIVERGED at canonical "
+                  f"instruction {div['instruction']}:\n"
+                  f"    default:     {div['default']}\n"
+                  f"    feature_off: {div['feature_off']}")
+
+    golden_path = Path(args.golden)
+    golden = json.loads(golden_path.read_text()) \
+        if golden_path.exists() else None
+    fingerprints = {}
+    for name, build in cases["fingerprint"]:
+        fp, _ = hlo_fingerprint(build(), n_rounds=args.n_rounds)
+        fingerprints[name] = fp
+        report["fingerprint"][name] = fp
+        print(f"[hlo_gate] fingerprint {name}: {fp}")
+
+    if args.update_golden:
+        golden_path.write_text(json.dumps({
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "n_rounds": args.n_rounds,
+            "cases": fingerprints,
+        }, indent=2, sort_keys=True) + "\n")
+        print(f"[hlo_gate] golden manifest rewritten -> {golden_path}")
+    elif golden is None:
+        print("[hlo_gate] WARNING: no golden manifest; run with "
+              "--update-golden to record one (identity pairs still gate)")
+    elif golden.get("jax") != jax.__version__ or \
+            golden.get("backend") != jax.default_backend():
+        print("[hlo_gate] WARNING: golden recorded under jax "
+              f"{golden.get('jax')}/{golden.get('backend')}, this process "
+              f"is {jax.__version__}/{jax.default_backend()} — HLO text "
+              "is not stable across jax releases, skipping hash "
+              "comparison (identity pairs still gate). Regenerate with "
+              "--update-golden after reviewing the program change.")
+        report["golden_skipped"] = True
+    else:
+        for name, fp in fingerprints.items():
+            want = golden["cases"].get(name)
+            if want is None:
+                print(f"[hlo_gate] WARNING: case {name} not in golden "
+                      "manifest (new case?) — add it with --update-golden")
+            elif want != fp:
+                failed = True
+                report["failures"].append(f"fingerprint:{name}")
+                print(f"[hlo_gate] fingerprint {name}: CHANGED "
+                      f"{want} -> {fp}. If deliberate, regenerate with "
+                      "--update-golden; otherwise an engine change "
+                      "perturbed this program's HLO.")
+        stale = set(golden["cases"]) - set(fingerprints)
+        if stale:
+            print(f"[hlo_gate] WARNING: golden has stale cases {sorted(stale)}")
+
+    if not args.skip_cache_check:
+        misses = _recompilation_storm_check(args.n_rounds)
+        report["jit_compiles_per_phase"] = misses
+        # Phase layout: [cold chunk1, warm chunk1 again, chunk2 (new
+        # n_rounds -> one legitimate compile), chunk2 again].
+        ok = misses[1] == 0 and misses[3] == 0
+        if not ok:
+            failed = True
+            report["failures"].append("recompilation-storm")
+            print("[hlo_gate] recompilation storm: per-phase compile "
+                  f"counts {misses} (re-driving an already-compiled "
+                  "program must not re-trace)")
+        else:
+            print(f"[hlo_gate] jit-cache: per-phase compiles {misses} OK")
+
+    report["elapsed_seconds"] = round(time.time() - t0, 2)
+    if args.report:
+        Path(args.report).write_text(json.dumps(report, indent=2) + "\n")
+    print(f"[hlo_gate] {'FAILED' if failed else 'PASSED'} in "
+          f"{report['elapsed_seconds']}s")
+    return 1 if failed else 0
+
+
+def _recompilation_storm_check(n_rounds: int) -> list:
+    """Compile counts per drive phase via jax.monitoring events."""
+    import jax
+
+    from gossipy_tpu.analysis.hlo import _make_sim
+
+    counts = {"n": 0}
+
+    def listener(event, **kw):
+        if "compil" in event.rsplit("/", 1)[-1]:
+            counts["n"] += 1
+
+    try:
+        jax.monitoring.register_event_listener(listener)
+    except Exception:
+        print("[hlo_gate] WARNING: jax.monitoring unavailable; "
+              "skipping the recompilation check")
+        return [0, 0, 0, 0]
+
+    sim = _make_sim()
+    key = jax.random.PRNGKey(0)
+    state = sim.init_nodes(key)
+    phases = []
+    for rounds in (n_rounds, n_rounds, n_rounds + 1, n_rounds + 1):
+        before = counts["n"]
+        state, _ = sim.start(state, n_rounds=rounds, key=key)
+        jax.block_until_ready(state.model.params)
+        phases.append(counts["n"] - before)
+    return phases
+
+
+if __name__ == "__main__":
+    sys.exit(main())

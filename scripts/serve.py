@@ -55,7 +55,7 @@ def load_specs(paths: list[str]) -> list:
     for r in requests:
         if r.tenant in seen:
             raise ValueError(f"duplicate tenant name {r.tenant!r} across "
-                             f"the given specs")
+                             "the given specs")
         seen.add(r.tenant)
     return requests
 

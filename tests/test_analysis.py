@@ -1,0 +1,324 @@
+"""Static-analysis layer: tracelint rules + baseline + the HLO gate.
+
+Covers the ISSUE-9 acceptance criteria:
+
+- ``python -m gossipy_tpu.analysis`` exits 0 on the final tree (zero
+  unsuppressed, un-baselined findings) and non-zero on a seeded
+  violation fixture;
+- every taint rule fires on a minimal traced-region violation and stays
+  quiet on the static-by-contract counterexamples;
+- the registry-completeness meta-test: an injected unregistered
+  ``health_bogus`` per-round field is flagged, and a simulated JSONL
+  schema v6 bump without a ``parse_line`` branch trips the tolerance
+  rule;
+- suppression comments, the file pragma, and the baseline waive exactly
+  what they claim;
+- HLO fingerprints are deterministic, identity pairs hold, and a
+  deliberate one-line engine perturbation produces a named
+  first-divergent-instruction report.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from gossipy_tpu.analysis import (
+    Finding,
+    baseline_from_findings,
+    filter_baselined,
+    run_tracelint,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def lint(sources=None):
+    return run_tracelint(REPO, sources=sources)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+TRACED_VIOLATIONS = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+import math
+
+def body(carry, x):
+    if carry > 0:                    # host-branch
+        carry = carry + 1
+    v = float(carry)                 # host-coerce
+    w = np.log(carry)                # np-in-trace
+    u = math.floor(carry)            # np-in-trace (math too)
+    y = carry[:x]                    # traced-slice
+    z = carry.item()                 # host-coerce
+    return carry, v
+
+def drive(init):
+    final, ys = jax.lax.scan(body, init, None, length=3)
+    return final
+'''
+
+
+class TestTaintRules:
+    def test_all_taint_rules_fire_on_seeded_module(self):
+        fs = lint({"gossipy_tpu/_seeded.py": TRACED_VIOLATIONS})
+        assert rules_of(fs) == ["host-branch", "host-coerce",
+                                "np-in-trace", "traced-slice"]
+        by_rule = {}
+        for f in fs:
+            by_rule.setdefault(f.rule, []).append(f)
+        assert len(by_rule["host-coerce"]) == 2    # float() + .item()
+        assert len(by_rule["np-in-trace"]) == 2    # np.log + math.floor
+        assert all(f.path == "gossipy_tpu/_seeded.py" for f in fs)
+
+    def test_host_code_is_not_linted(self):
+        src = '''
+def host_only(x):
+    if x > 0:          # never traced: no finding
+        return float(x)
+    return 0.0
+'''
+        assert lint({"gossipy_tpu/_host.py": src}) == []
+
+    def test_static_by_contract_is_quiet(self):
+        src = '''
+import jax
+import jax.numpy as jnp
+
+def body(carry, flag: bool, k: int):
+    if flag:                       # bool-annotated: static
+        carry = carry + k
+    n = int(carry.shape[0])        # shape access is static
+    if carry is None:              # identity test is static
+        return carry
+    for leaf in jax.tree.leaves(carry):   # host container of leaves
+        carry = carry + leaf.sum()
+    return carry
+
+def drive(init):
+    return jax.lax.fori_loop(0, 3, lambda i, c: body(c, True, 1), init)
+'''
+        assert lint({"gossipy_tpu/_static_ok.py": src}) == []
+
+    def test_static_argnames_params_are_static(self):
+        src = '''
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def kernel(x, block):
+    pad = (-x.shape[0]) % block
+    if pad:                        # static: block is static_argnames
+        x = jnp.pad(x, (0, pad))
+    return x
+'''
+        assert lint({"gossipy_tpu/_statics.py": src}) == []
+
+    def test_io_callback_body_is_host_side(self):
+        src = '''
+import jax
+import jax.numpy as jnp
+
+def step(carry, _):
+    def cb(v):
+        print(float(v))            # host callback: no finding
+    jax.experimental.io_callback(cb, None, carry, ordered=True)
+    return carry, ()
+
+def drive(init):
+    return jax.lax.scan(step, init, None, length=2)
+'''
+        assert lint({"gossipy_tpu/_cb.py": src}) == []
+
+    def test_use_after_donate(self):
+        src = '''
+def go(sim, state, key):
+    out, rep = sim.start(state, n_rounds=2, key=key)
+    bad = state.round              # donated buffer read
+    return out, bad
+
+def ok_rebind(sim, state, key):
+    state, rep = sim.start(state, n_rounds=2, key=key)
+    return state.round             # rebound: fine
+
+def ok_optout(sim, state, key):
+    out, rep = sim.start(state, n_rounds=2, key=key,
+                         donate_state=False)
+    return state.round             # donation disabled: fine
+'''
+        fs = lint({"gossipy_tpu/_donate.py": src})
+        assert rules_of(fs) == ["use-after-donate"]
+        assert len(fs) == 1 and fs[0].line == 4
+
+
+class TestRegistryRules:
+    def test_unregistered_per_round_field_is_flagged(self):
+        eng_path = REPO / "gossipy_tpu" / "simulation" / "engine.py"
+        src = eng_path.read_text() + (
+            "\n\ndef _seeded_stats(stats):\n"
+            "    stats[\"health_bogus\"] = 1\n")
+        fs = lint({"gossipy_tpu/simulation/engine.py": src})
+        assert rules_of(fs) == ["registry-field"]
+        assert "health_bogus" in fs[0].message
+
+    def test_registered_fields_pass(self):
+        # The real tree's stat keys are all registered (this is the
+        # standing invariant the rule protects).
+        assert [f for f in lint() if f.rule == "registry-field"] == []
+
+    def test_schema_bump_without_parse_line_branch_is_flagged(self):
+        ev_path = REPO / "gossipy_tpu" / "simulation" / "events.py"
+        src = ev_path.read_text().replace("SCHEMA = 5", "SCHEMA = 6")
+        assert "SCHEMA = 6" in src
+        fs = lint({"gossipy_tpu/simulation/events.py": src})
+        assert rules_of(fs) == ["schema-tolerance"]
+        assert "if schema < 6" in fs[0].message
+
+    def test_schema_bump_with_branch_passes(self):
+        ev_path = REPO / "gossipy_tpu" / "simulation" / "events.py"
+        src = ev_path.read_text().replace("SCHEMA = 5", "SCHEMA = 6")
+        src = src.replace(
+            "        if schema < 5:",
+            "        if schema < 6:\n"
+            "            row.setdefault(\"future\", None)\n"
+            "        if schema < 5:")
+        fs = lint({"gossipy_tpu/simulation/events.py": src})
+        assert [f for f in fs if f.rule == "schema-tolerance"] == []
+
+
+class TestSuppressionAndBaseline:
+    def test_line_suppression(self):
+        src = TRACED_VIOLATIONS.replace(
+            "v = float(carry)                 # host-coerce",
+            "v = float(carry)  # tracelint: disable=host-coerce")
+        fs = lint({"gossipy_tpu/_seeded.py": src})
+        assert len([f for f in fs if f.rule == "host-coerce"]) == 1  # .item
+
+    def test_file_pragma(self):
+        src = ("# tracelint: disable-file=all\n") + TRACED_VIOLATIONS
+        assert lint({"gossipy_tpu/_seeded.py": src}) == []
+
+    def test_baseline_waives_by_identity_not_line_number(self):
+        fs = lint({"gossipy_tpu/_seeded.py": TRACED_VIOLATIONS})
+        base = baseline_from_findings(fs)
+        assert filter_baselined(fs, base) == []
+        # Shift every line down: identical findings still waived.
+        shifted = lint({"gossipy_tpu/_seeded.py":
+                        "\n\n\n" + TRACED_VIOLATIONS})
+        assert filter_baselined(shifted, base) == []
+        # A NEW violation is not.
+        more = TRACED_VIOLATIONS.replace(
+            "return carry, v", "q = bool(carry)\n    return carry, v")
+        fs2 = lint({"gossipy_tpu/_seeded.py": more})
+        new = filter_baselined(fs2, base)
+        assert len(new) == 1 and new[0].rule == "host-coerce"
+
+    def test_committed_baseline_is_empty(self):
+        # The tree is clean: the committed baseline waives nothing, so
+        # any future finding is NEW by construction.
+        base = json.loads(
+            (REPO / "gossipy_tpu" / "analysis" / "baseline.json")
+            .read_text())
+        assert base["findings"] == {}
+
+
+class TestCLI:
+    def test_exits_zero_on_clean_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "gossipy_tpu.analysis"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 new" in proc.stdout
+
+    def test_exits_nonzero_on_seeded_violation(self, tmp_path):
+        fixture = tmp_path / "repo"
+        shutil.copytree(REPO / "gossipy_tpu", fixture / "gossipy_tpu",
+                        ignore=shutil.ignore_patterns("__pycache__"))
+        target = fixture / "gossipy_tpu" / "simulation" / "engine.py"
+        target.write_text(target.read_text() + TRACED_VIOLATIONS)
+        proc = subprocess.run(
+            [sys.executable, "-m", "gossipy_tpu.analysis",
+             "--root", str(fixture),
+             "--json", str(tmp_path / "findings.json")],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        out = json.loads((tmp_path / "findings.json").read_text())
+        assert out["new"] and {f["rule"] for f in out["new"]} >= {
+            "host-coerce", "host-branch"}
+
+
+class TestFindingIdentity:
+    def test_key_is_content_addressed(self):
+        a = Finding("host-coerce", "x.py", 10, 0, "m", "v = float(c)")
+        b = Finding("host-coerce", "x.py", 99, 4, "m2", "v = float(c)")
+        c = Finding("host-coerce", "x.py", 10, 0, "m", "w = float(c)")
+        assert a.key == b.key
+        assert a.key != c.key
+
+
+class TestHLOHelpers:
+    """Pure-text fingerprint helpers (no jax tracing)."""
+
+    def test_first_divergence_reports_position(self):
+        from gossipy_tpu.analysis import first_divergence
+        a = "op1\nop2\nop3"
+        b = "op1\nopX\nop3"
+        div = first_divergence(a, b)
+        assert div["instruction"] == 2
+        assert div["a"] == "op2" and div["b"] == "opX"
+        assert first_divergence(a, a) is None
+
+    def test_canonicalization_strips_locations_only(self):
+        from gossipy_tpu.analysis import canonicalize_hlo
+        raw = ('module @jit_run {\n'
+               '  %0 = stablehlo.add %a, %b loc("eng.py":10:2)\n'
+               '#loc1 = loc("x")\n'
+               '\n  }\n')
+        canon = canonicalize_hlo(raw)
+        assert 'loc(' not in canon
+        assert 'stablehlo.add %a, %b' in canon
+        assert '' not in canon.split("\n")
+
+    def test_golden_manifest_matches_gate_case_names(self):
+        from gossipy_tpu.analysis.hlo import gate_cases
+        golden = json.loads(
+            (REPO / "gossipy_tpu" / "analysis" / "hlo_golden.json")
+            .read_text())
+        assert set(golden["cases"]) == {
+            name for name, _ in gate_cases()["fingerprint"]}
+
+
+@pytest.mark.slow
+class TestHLOGate:
+    """Lowering-based checks (each builds + AOT-lowers small programs;
+    compile-free but trace-heavy — slow lane, the CI static-analysis job
+    runs scripts/hlo_gate.py over the full matrix instead)."""
+
+    def test_fingerprint_deterministic(self):
+        from gossipy_tpu.analysis import hlo_fingerprint
+        from gossipy_tpu.analysis.hlo import _make_sim
+        fp1, _ = hlo_fingerprint(_make_sim())
+        fp2, _ = hlo_fingerprint(_make_sim())
+        assert fp1 == fp2
+
+    def test_perturbation_names_first_divergent_instruction(self):
+        # The acceptance fixture: a one-line engine-config perturbation
+        # (mailbox capacity 2 -> 3 changes the deliver fori_loop bounds)
+        # must produce a named first-divergent-instruction report.
+        from gossipy_tpu.analysis import assert_identical_hlo
+        from gossipy_tpu.analysis.hlo import _make_sim
+        with pytest.raises(AssertionError) as exc:
+            assert_identical_hlo(_make_sim(mailbox_slots=2),
+                                 _make_sim(mailbox_slots=3),
+                                 label="seeded perturbation")
+        msg = str(exc.value)
+        assert "canonical instruction" in msg
+        assert "sim_a:" in msg and "sim_b:" in msg

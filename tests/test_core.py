@@ -1,7 +1,6 @@
 """Tests for enums, topologies, delays, mixing matrices (gossipy_tpu.core)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

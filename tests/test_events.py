@@ -1,6 +1,5 @@
 """Event-stream tests (reference Observer pattern, simul.py:37-177)."""
 
-import jax
 import numpy as np
 
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology

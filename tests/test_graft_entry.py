@@ -3,7 +3,6 @@
 import os
 import subprocess
 
-import pytest
 
 
 def test_entry_pins_cpu_when_probe_wedges(monkeypatch):

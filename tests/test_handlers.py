@@ -4,7 +4,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from gossipy_tpu.compression import ModelPartition, sample_mask, sampled_merge
 from gossipy_tpu.core import CreateModelMode

@@ -197,16 +197,16 @@ class TestSentinelsOffIsUntouched:
     def test_sentinels_off_hlo_identical(self):
         """The sentinels=None trace is the same program as one built
         without the argument at all (every addition is behind the
-        trace-time gate) — the ISSUE-4 acceptance criterion."""
-        sim_default = make_sim()
-        sim_off = make_sim(sentinels=None)
-        key = jax.random.PRNGKey(0)
-        st = sim_default.init_nodes(key)
-        hlo_a = sim_default.lower_start(st, n_rounds=2, key=key).as_text()
-        hlo_b = sim_off.lower_start(st, n_rounds=2, key=key).as_text()
-        assert hlo_a == hlo_b
+        trace-time gate) — the ISSUE-4 acceptance criterion. Shares the
+        hlo_gate backbone (scripts/hlo_gate.py runs the same pair in
+        CI); on divergence the first differing instruction is named."""
+        from gossipy_tpu.analysis import assert_identical_hlo
+        assert_identical_hlo(make_sim(), make_sim(sentinels=None),
+                             label="sentinels=None")
 
     def test_all2all_sentinels_off_hlo_identical(self):
+        from gossipy_tpu.analysis import assert_identical_hlo
+
         def build(**kw):
             topo = Topology.random_regular(N, 4, seed=3)
             handler = WeightedSGDHandler(
@@ -217,11 +217,8 @@ class TestSentinelsOffIsUntouched:
             return All2AllGossipSimulator(handler, topo, make_stacked(),
                                           delta=20,
                                           mixing=uniform_mixing(topo), **kw)
-        key = jax.random.PRNGKey(0)
-        sim_a, sim_b = build(), build(sentinels=None)
-        st = sim_a.init_nodes(key)
-        assert sim_a.lower_start(st, n_rounds=2, key=key).as_text() == \
-            sim_b.lower_start(st, n_rounds=2, key=key).as_text()
+        assert_identical_hlo(build(), build(sentinels=None),
+                             label="all2all sentinels=None")
 
 
 class TestHealthyRunVitals:

@@ -114,14 +114,12 @@ class TestProbesOffIsUntouched:
     def test_probes_off_hlo_identical(self):
         """The probes=None trace is the same program as one built without
         the argument at all (the feature's additions are all behind the
-        trace-time gate)."""
-        sim_default = make_sim()
-        sim_off = make_sim(probes=None)
-        key = jax.random.PRNGKey(0)
-        st = sim_default.init_nodes(key)
-        hlo_a = sim_default.lower_start(st, n_rounds=2, key=key).as_text()
-        hlo_b = sim_off.lower_start(st, n_rounds=2, key=key).as_text()
-        assert hlo_a == hlo_b
+        trace-time gate). Shares the hlo_gate backbone — on divergence the
+        first differing instruction is named (scripts/hlo_gate.py runs the
+        same pair in CI)."""
+        from gossipy_tpu.analysis import assert_identical_hlo
+        assert_identical_hlo(make_sim(), make_sim(probes=None),
+                             label="probes=None")
 
 
 class TestConsensus:

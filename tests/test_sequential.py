@@ -6,7 +6,6 @@ engine. The torch-reference comparison lives in the parity lane
 import numpy as np
 import jax
 import optax
-import pytest
 
 from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
     Topology, UniformDelay

@@ -8,7 +8,6 @@ import pytest
 
 from gossipy_tpu.core import (
     AntiEntropyProtocol,
-    ConstantDelay,
     CreateModelMode,
     Topology,
     UniformDelay,

@@ -242,10 +242,11 @@ class TestReport:
 
 class TestPhaseScopes:
     def test_compiled_hlo_contains_all_four_scopes(self, key):
+        from gossipy_tpu.analysis import compiled_text
         sim = faulty_sim(n_nodes=12, drop_prob=0.0, online_prob=1.0,
                          mailbox_slots=2)
         st = sim.init_nodes(key)
-        txt = sim.lower_start(st, n_rounds=2, key=key).compile().as_text()
+        txt = compiled_text(sim, st, key, n_rounds=2)
         assert phases_in_text(txt) == list(ROUND_PHASES)
 
     def test_profiler_trace_contains_scopes(self, tmp_path, key):

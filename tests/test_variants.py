@@ -8,7 +8,6 @@ import pytest
 
 from gossipy_tpu.compression import ModelPartition
 from gossipy_tpu.core import (
-    AntiEntropyProtocol,
     CreateModelMode,
     Topology,
     UniformDelay,
@@ -27,7 +26,7 @@ from gossipy_tpu.handlers import (
     WeightedSGDHandler,
     losses,
 )
-from gossipy_tpu.models import LogisticRegression, MLP
+from gossipy_tpu.models import LogisticRegression
 from gossipy_tpu.simulation import (
     All2AllGossipSimulator,
     CacheNeighGossipSimulator,
