@@ -71,6 +71,12 @@ SENTINEL_INFO: dict = {}
 # scenario — a half/half partition plus a drop spike inside the measured
 # window. Merged into raw.
 CHAOS_INFO: dict = {}
+# Performance-observability stamp (telemetry.cost; the measured run now
+# carries perf=True): XLA's per-round FLOP count, the program's HBM peak
+# from memory_analysis(), and the measured-wall-time MFU estimate (null
+# off known accelerators). Merged into raw — EVERY bench row carries the
+# trio so a TPU window banks its on-chip evidence automatically.
+PERF_INFO: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -175,8 +181,34 @@ def bench_chaos_config(n_rounds: int):
         horizon=n_rounds)
 
 
+def stamp_perf(sim) -> None:
+    """``PERF_INFO`` raw fields from a perf-enabled simulator's
+    :meth:`perf_summary` — the uniform ``mfu_est`` / ``flops_per_round``
+    / ``hbm_peak_bytes`` trio. Null-safe and best-effort: a stamp
+    failure must never kill a finished measurement."""
+    try:
+        ps = sim.perf_summary()
+    except Exception as e:
+        print(f"[bench] perf stamp failed: {e!r}", file=sys.stderr)
+        return
+    if ps is None:
+        return
+    last = ps.get("last_run") or {}
+    mfu = last.get("mfu_est")
+    PERF_INFO.update({
+        "mfu_est": round(mfu, 4) if mfu is not None else None,
+        "flops_per_round": ps.get("flops_per_round_xla"),
+        "hbm_peak_bytes": ps.get("hbm_peak_bytes"),
+        "analytic_flops_per_round": (ps.get("analytic") or {})
+        .get("flops_per_round"),
+    })
+    print(f"[bench] perf: {PERF_INFO['flops_per_round']} FLOP/round "
+          f"(XLA), hbm peak {PERF_INFO['hbm_peak_bytes']} B, "
+          f"mfu_est {PERF_INFO['mfu_est']}", file=sys.stderr)
+
+
 def build_sim(X, y, fused: bool = False, probes: bool = False,
-              sentinels: bool = False, chaos=None):
+              sentinels: bool = False, chaos=None, perf: bool = False):
     """The bench configuration (shared by the throughput and to-accuracy
     modes): 100 nodes, LogReg SGD, MERGE_UPDATE, PUSH over a 20-regular
     graph, per-round global eval."""
@@ -204,17 +236,19 @@ def build_sim(X, y, fused: bool = False, probes: bool = False,
                            history_dtype=HISTORY_DTYPE,
                            probes=probes,
                            sentinels=sentinels,
-                           chaos=chaos)
+                           chaos=chaos,
+                           perf=perf)
 
 
 def bench_ours(X, y) -> float:
     import jax
 
     def run(fused: bool, probes: bool = False, sentinels: bool = False,
-            chaos=None) -> tuple[float, float, object, object]:
+            chaos=None, perf: bool = False
+            ) -> tuple[float, float, object, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
         sim = build_sim(X, y, fused, probes=probes, sentinels=sentinels,
-                        chaos=chaos)
+                        chaos=chaos, perf=perf)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
         # Warmup: trigger compilation of the scan (donate_state=False: the
@@ -230,11 +264,11 @@ def bench_ours(X, y) -> float:
             report
 
     n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
-    elapsed, acc, sim, report = run(False)
+    elapsed, acc, sim, report = run(False, perf=True)
     label = "plain"
     if jax.default_backend() == "tpu":
         try:  # pallas fused deliver path: keep whichever is faster on this chip
-            elapsed_f, acc_f, sim_f, report_f = run(True)
+            elapsed_f, acc_f, sim_f, report_f = run(True, perf=True)
             print(f"[bench] fused: {n_rounds} rounds in {elapsed_f:.2f}s",
                   file=sys.stderr)
             if elapsed_f < elapsed:
@@ -304,6 +338,7 @@ def bench_ours(X, y) -> float:
     except Exception as e:  # the A/B must not kill the main measurement
         print(f"[bench] chaos A/B failed ({e!r})", file=sys.stderr)
     stamp_wire_traffic(sim, report, n_rounds)
+    stamp_perf(sim)
     emit_manifest(sim, f"north-star/{label}")
     return n_rounds / elapsed
 
@@ -408,16 +443,25 @@ def bench_to_accuracy(X, y, target: float) -> None:
               f"in {elapsed:.2f}s wall")
 
 
-# Peak dense matmul throughput per chip, by PJRT device_kind. MFU is quoted
-# against the bf16 MXU peak (the rate the CNN config's convs run at with
-# --bf16); fp32 configs on TPU still route through the MXU via multi-pass
-# bf16, so the bf16 peak stays the honest denominator.
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e: 197 bf16 TFLOP/s per chip
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v5p": 459e12,
-}
+def _peak_flops_table() -> dict:
+    """The per-chip bf16 peak table now lives in ONE place —
+    ``gossipy_tpu.telemetry.cost.PEAK_FLOPS`` — shared by this bench, the
+    RunManifest ``perf`` block and the scale ladder, so the MFU
+    denominator cannot drift between them. (Deferred import: importing
+    the package pulls in jax, and bench's module import must stay
+    jax-free so argv errors and the degrade re-exec never touch a
+    possibly-wedged plugin.)"""
+    from gossipy_tpu.telemetry.cost import PEAK_FLOPS
+    return PEAK_FLOPS
+
+
+def __getattr__(name: str):
+    # Back-compat module attribute (tests and external callers read
+    # ``bench.PEAK_FLOPS``), resolved lazily through the one shared
+    # definition above.
+    if name == "PEAK_FLOPS":
+        return _peak_flops_table()
+    raise AttributeError(name)
 
 
 def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
@@ -540,22 +584,27 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
     key = jrandom.PRNGKey(42)
     state = sim.init_nodes(key, common_init=True)
 
-    def flops_of_one_round(s) -> float | None:
-        # XLA's HLO cost model counts a while/scan body ONCE regardless of
-        # trip count (verified: 1-round and 10-round programs report equal
-        # flops), so a 1-round program gives per-round FLOPs directly.
-        cost = s.lower_start(state, n_rounds=1, key=key).compile() \
-            .cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0]
-        f = float(cost.get("flops", float("nan")))
-        return f if np.isfinite(f) else None
+    from gossipy_tpu.telemetry.cost import cost_report_for
+
+    cost_reports = {}
+
+    def flops_of_one_round(s, label: str) -> float | None:
+        # XLA's HLO cost model counts a while/scan body ONCE regardless
+        # of trip count (verified: 1-round and 10-round programs report
+        # equal flops), so a 1-round program gives per-round FLOPs
+        # directly. The capture is telemetry.cost.CostReport — the same
+        # record the perf= layer banks — so the row also gets the
+        # program's memory_analysis() numbers for free.
+        cr = cost_report_for(s, state, key, n_rounds=1, label=label)
+        if cr is not None:
+            cost_reports[label] = cr
+        return cr.flops if cr is not None else None
 
     # Rounds on which _maybe_eval actually evaluates (incl. the forced
     # final-round eval).
     n_evals = sum(1 for r in range(rounds)
                   if (r + 1) % eval_every == 0 or r == rounds - 1)
-    f_with_eval = flops_of_one_round(make_sim(stacked, 1))
+    f_with_eval = flops_of_one_round(make_sim(stacked, 1), "with_eval")
     if DEGRADED or eval_every == 1:
         # Off-accelerator MFU is null anyway (unknown device kind) — skip
         # the second CNN compile and fall back to the undecomposed count.
@@ -563,7 +612,7 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
         flops_total = (f_with_eval * rounds
                        if f_with_eval is not None else None)
     else:
-        f_base = flops_of_one_round(make_sim(no_eval, 1))
+        f_base = flops_of_one_round(make_sim(no_eval, 1), "base")
         if f_with_eval is not None and f_base is not None:
             flops_total = rounds * f_base + \
                 n_evals * max(f_with_eval - f_base, 0.0)
@@ -599,11 +648,12 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
     emit_manifest(sim, f"mfu/{variant}")
     achieved = flops_total / elapsed if flops_total is not None else None
     kind = jax.devices()[0].device_kind
-    peak = PEAK_FLOPS.get(kind)
+    peak = _peak_flops_table().get(kind)
     if peak is None:
         print(f"[mfu] WARNING: unknown device_kind {kind!r} — MFU will be "
               "null. Add this chip's bf16 dense-matmul peak (FLOP/s) to "
-              "PEAK_FLOPS in bench.py to get a value.", file=sys.stderr)
+              "PEAK_FLOPS in gossipy_tpu/telemetry/cost.py to get a "
+              "value.", file=sys.stderr)
     mfu = achieved / peak if (peak and achieved is not None) else None
     print(f"[mfu] {kind}: {rounds} rounds in {elapsed:.2f}s "
           f"({elapsed / rounds * 1e3:.1f} ms/round)"
@@ -633,6 +683,13 @@ def bench_mfu(rounds: int = 50, n_nodes: int | None = None,
             "eval_every": eval_every,
             "n_eval_rounds": n_evals,
             "ms_per_round": round(elapsed / rounds * 1e3, 2),
+            # The uniform perf-stamp trio every bench row now carries
+            # (telemetry.cost): the on-chip evidence banks itself the
+            # moment a TPU window opens, with zero extra work.
+            "mfu_est": round(mfu, 4) if mfu is not None else None,
+            "flops_per_round": f_with_eval,
+            "hbm_peak_bytes": (cost_reports["with_eval"].peak_bytes
+                               if "with_eval" in cost_reports else None),
             "xla_flops_per_round_with_eval": f_with_eval,
             "xla_flops_per_round_base": f_base,
             "xla_flops_executed_total": flops_total,
@@ -705,6 +762,7 @@ def _scale_harness(n_nodes: int, rounds: int, build_sim):
     elapsed = time.perf_counter() - t0
     stamp("done")
     stamp_wire_traffic(sim, report, rounds)
+    stamp_perf(sim)
     emit_manifest(sim, "scale")
     acc = report.curves(local=False)["accuracy"][-1]
     return rounds / elapsed, float(acc), build_s
@@ -742,7 +800,7 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
         sim = GossipSimulator(handler, topo, disp.stacked(), delta=ROUND_LEN,
                               protocol=AntiEntropyProtocol.PUSH,
                               sampling_eval=0.01, eval_every=rounds,
-                              history_dtype=HISTORY_DTYPE)
+                              history_dtype=HISTORY_DTYPE, perf=True)
         return sim, build_s
 
     rate, acc, build_s = _scale_harness(n_nodes, rounds, build_sim)
@@ -754,6 +812,7 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
         "unit": "rounds/s",
         "vs_baseline": None,
         "raw": {
+            **PERF_INFO,
             "n_nodes": n_nodes,
             "degree": DEGREE,
             "rounds": rounds,
@@ -794,7 +853,8 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
         build_s = time.perf_counter() - t0
         sim = All2AllGossipSimulator(handler, topo, disp.stacked(),
                                      delta=ROUND_LEN, mixing=mixing,
-                                     sampling_eval=0.01, eval_every=rounds)
+                                     sampling_eval=0.01, eval_every=rounds,
+                                     perf=True)
         return sim, build_s
 
     rate, acc, build_s = _scale_harness(n_nodes, rounds, build_sim)
@@ -806,6 +866,7 @@ def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
         "unit": "rounds/s",
         "vs_baseline": None,
         "raw": {
+            **PERF_INFO,
             "n_nodes": n_nodes,
             "degree": DEGREE,
             "rounds": rounds,
@@ -1017,10 +1078,14 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
         else None)
 
     def run(fused: bool) -> float:
+        # perf=True on the plain leg: the row's uniform perf trio
+        # (raw.mfu_est / flops_per_round / hbm_peak_bytes) comes from
+        # the same config the plain timing measured.
         sim = GossipSimulator(handler, Topology.clique(n), disp.stacked(),
                               delta=ROUND_LEN,
                               protocol=AntiEntropyProtocol.PUSH,
-                              eval_every=rounds, fused_merge=fused)
+                              eval_every=rounds, fused_merge=fused,
+                              perf=not fused)
         key = jax.random.PRNGKey(0)
         state = sim.init_nodes(key, common_init=True)
         s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile
@@ -1029,6 +1094,8 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
         t0 = time.perf_counter()
         s3, _ = sim.start(state, n_rounds=rounds, key=key)
         jax.block_until_ready(s3.model.params)
+        if not fused:
+            stamp_perf(sim)
         return (time.perf_counter() - t0) / rounds * 1e3  # ms/round
 
     plain_ms = run(False)
@@ -1051,6 +1118,7 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
         "unit": "x_vs_xla_gather_blend",
         "vs_baseline": round(speedup, 3) if speedup else None,
         "raw": {
+            **PERF_INFO,
             "plain_ms_per_round": round(plain_ms, 2),
             "fused_ms_per_round": (round(fused_ms, 2)
                                    if fused_ms is not None else None),
@@ -1464,6 +1532,7 @@ def main():
             **PROBE_INFO,
             **SENTINEL_INFO,
             **CHAOS_INFO,
+            **PERF_INFO,
             "ours_rounds_per_sec": round(ours, 2),
             "ours_rounds_measured": (BENCH_ROUNDS_DEGRADED if DEGRADED
                                      else BENCH_ROUNDS),

@@ -198,6 +198,12 @@ def gate_cases() -> dict:
          lambda: _make_sim(), lambda: _make_sim(sentinels=None)),
         ("engine/chaos-off",
          lambda: _make_sim(), lambda: _make_sim(chaos=None)),
+        ("engine/perf-off",
+         lambda: _make_sim(), lambda: _make_sim(perf=None)),
+        # perf is host-side only, so even perf ON must be HLO-neutral —
+        # stronger than the other layers' off-identity contract.
+        ("engine/perf-on",
+         lambda: _make_sim(), lambda: _make_sim(perf=True)),
         ("all2all/sentinels-off",
          lambda: _make_sim(all2all=True),
          lambda: _make_sim(all2all=True, sentinels=None)),

@@ -44,7 +44,8 @@ use-after-donate    a buffer passed to a donating call
                     (``donate_state=True`` by default on ``start``, or
                     ``donate_argnums``) is read again afterwards — the
                     donated input is invalidated
-registry-field      a ``probe_*``/``health_*``/``chaos_*`` per-round stat
+registry-field      a ``probe_*``/``health_*``/``chaos_*``/``perf_*``
+                    per-round stat
                     key that is missing from the report registry
                     (``PER_ROUND_FIELDS``/``STATIC_FIELDS``) — it would
                     silently vanish from save/load/concatenate
@@ -121,7 +122,7 @@ _METHOD_DENYLIST = {
     "total",
 }
 
-_STAT_KEY_RE = re.compile(r"^(probe|health|chaos)_[a-z0-9_]+$")
+_STAT_KEY_RE = re.compile(r"^(probe|health|chaos|perf)_[a-z0-9_]+$")
 _SUPPRESS_RE = re.compile(r"#\s*tracelint:\s*disable=([a-z\-,\s]+|all)")
 _SUPPRESS_FILE_RE = re.compile(
     r"#\s*tracelint:\s*disable-file=([a-z\-,\s]+|all)")

@@ -19,7 +19,10 @@ telemetry routing and failure handling live in a host-side control plane
   :class:`~gossipy_tpu.simulation.report.SimulationReport` and its own
   per-tenant :class:`~gossipy_tpu.telemetry.RunManifest` (fault
   rates/seed patched to the TENANT's values, bucket + signature + the
-  bucket's compilation-cache delta stamped into ``extra.service``);
+  bucket's compilation-cache delta stamped into ``extra.service``,
+  plus per-tenant cost attribution — tenant-seconds of measured slice
+  wall time and estimated FLOPs from the step program's own
+  ``cost_analysis()`` — under ``extra.service.perf``);
 - **survives tenant failure**: each slice's start states are kept as
   host-side last-healthy copies; when a tenant's in-graph ``health_trip``
   sentinel fires, the scheduler writes that tenant's flight-recorder
@@ -105,6 +108,17 @@ class _BucketRuntime:
         self._cache_events_before = dict(
             compilation_cache_stats().get("events", {}))
         self._cache_delta: dict = {}
+        # Per-tenant cost attribution (telemetry.cost): wall seconds of
+        # the bucket's slices split evenly across the live lanes, and
+        # estimated FLOPs = the step program's own cost_analysis count
+        # divided by the lane count (the vmapped program widens every op
+        # by T; XLA counts the scan body once, so program flops ≈ one
+        # round of all T lanes) times the rounds the tenant actually
+        # took. Stamped into each per-tenant manifest's extra.service.
+        self._tenant_seconds = [0.0] * len(runs)
+        self._tenant_flops = [0.0] * len(runs)
+        self._step_cost = None
+        self._step_compiled = None
         # Metric names must be resolved from CONCRETE data before the
         # step program traces with tracer-rebound sim.data (_maybe_eval
         # consults them at trace time under eval_every > 1).
@@ -235,17 +249,28 @@ class _BucketRuntime:
         chunk_start = self.rounds_done
         saved_axis = self.sim._batch_axis_name
         self.sim._batch_axis_name = BATCH_AXIS
+        t_slice0 = time.perf_counter()
         try:
             try:
-                self.states, self.hc, stats = self._step_fn(
-                    self.states, self.keys, self.data, self.drop,
-                    self.online, self.hc, self.chaos_scheds)
+                step_args = (self.states, self.keys, self.data, self.drop,
+                             self.online, self.hc, self.chaos_scheds)
+                if self._step_compiled is None:
+                    self._step_compiled = self._compile_step(step_args)
+                self.states, self.hc, stats = self._step_compiled(
+                    *step_args)
                 host = jax.tree.map(np.asarray, stats)
             except Exception as e:  # the whole bucket program died
                 self._fail_all(e, chunk_start)
                 return
         finally:
             self.sim._batch_axis_name = saved_axis
+        # The host transfer above forces completion, so this wall time is
+        # the slice's real cost, attributed evenly across live lanes.
+        slice_wall = time.perf_counter() - t_slice0
+        per_lane_round_flops = (
+            self._step_cost.flops / max(self.bucket.size, 1)
+            if self._step_cost is not None and self._step_cost.flops
+            else None)
         if not self._cache_delta:
             self._cache_delta = self._compute_cache_delta()
         self.rounds_done += self.slice_rounds
@@ -260,6 +285,11 @@ class _BucketRuntime:
             if self.sentinels_on and "health_trip" in rows:
                 nz = np.nonzero(np.asarray(rows["health_trip"]) > 0)[0]
                 trip_idx = int(nz[0]) if nz.size else None
+            self._tenant_seconds[i] += slice_wall / len(lanes)
+            if per_lane_round_flops is not None:
+                rounds_taken = take if trip_idx is None else trip_idx + 1
+                self._tenant_flops[i] += \
+                    per_lane_round_flops * rounds_taken
             if trip_idx is not None:
                 rows = {k: v[:trip_idx + 1] for k, v in rows.items()}
                 self._harvest_rows(i, rows, chunk_start)
@@ -272,6 +302,24 @@ class _BucketRuntime:
                     self._finalize(i, RunStatus.DONE)
         if not self._live_lanes():
             self.live = False
+
+    def _compile_step(self, args):
+        """AOT-compile the bucket's ONE step program (the same program
+        the dispatch jit would build) so its ``cost_analysis()`` /
+        ``memory_analysis()`` can be banked for per-tenant FLOP
+        attribution. Falls back to the dispatch jit when the backend
+        resists AOT — attribution then degrades to tenant-seconds
+        only."""
+        try:
+            compiled = self._step_fn.lower(*args).compile()
+        except Exception:
+            return self._step_fn
+        from ..telemetry.cost import CostReport
+        self._step_cost = CostReport.from_compiled(
+            compiled,
+            label=f"service/step[{self.bucket.signature.digest[:8]}]",
+            n_rounds=self.slice_rounds)
+        return compiled
 
     def _compute_cache_delta(self) -> dict:
         stats = compilation_cache_stats()
@@ -347,6 +395,19 @@ class _BucketRuntime:
                 "rounds_completed": h.rounds_completed,
                 "status": h.status.value,
                 "bucket_compilation_cache": self._cache_delta,
+                # Cost attribution for THIS tenant: its share of the
+                # bucket's measured wall time and its estimated FLOPs
+                # (null-safe: flops need the step program's AOT cost
+                # capture, which some backends cannot provide).
+                "perf": {
+                    "tenant_seconds": round(self._tenant_seconds[i], 6),
+                    "tenant_flops_est": (self._tenant_flops[i]
+                                         if self._step_cost is not None
+                                         else None),
+                    "step_program": (self._step_cost.to_dict()
+                                     if self._step_cost is not None
+                                     else None),
+                },
             }},
             config_overrides={"drop_prob": cfg.drop_prob,
                               "online_prob": cfg.online_prob,
@@ -443,11 +504,22 @@ class _BucketRuntime:
         }
         # jit-cache proof of megabatching: one compiled step program per
         # bucket regardless of tenant count (the acceptance counter).
-        for name, fn in (("init", self._init_fn), ("step", self._step_fn)):
+        try:
+            out["init_jit_cache_size"] = int(self._init_fn._cache_size())
+        except Exception:
+            out["init_jit_cache_size"] = None
+        if self._step_compiled is not None \
+                and self._step_compiled is not self._step_fn:
+            # Stepping went through the AOT-compiled executable (the
+            # cost-capture path): ONE step program by construction — the
+            # dispatch jit's cache is empty because it was never called.
+            out["step_jit_cache_size"] = 1
+        else:
             try:
-                out[f"{name}_jit_cache_size"] = int(fn._cache_size())
+                out["step_jit_cache_size"] = int(
+                    self._step_fn._cache_size())
             except Exception:
-                out[f"{name}_jit_cache_size"] = None
+                out["step_jit_cache_size"] = None
         return out
 
 

@@ -61,6 +61,12 @@ from ..telemetry import (
     ProbeConfig,
     emit_event,
 )
+from ..telemetry.cost import (
+    PERF_STAT_KEYS,
+    CostReport,
+    PerfConfig,
+    mfu_estimate,
+)
 from ..telemetry.health import (
     HEALTH_STAT_KEYS,
     HealthCarry,
@@ -466,7 +472,8 @@ class GossipSimulator(SimulationEventSender):
                  history_dtype: str = "float32",
                  probes: Union[None, bool, ProbeConfig] = None,
                  sentinels: Union[None, bool, SentinelConfig] = None,
-                 chaos: Union[None, dict, ChaosConfig] = None):
+                 chaos: Union[None, dict, ChaosConfig] = None,
+                 perf: Union[None, bool, PerfConfig] = None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -622,6 +629,16 @@ class GossipSimulator(SimulationEventSender):
         # count, edge-mask form) live on the simulator, the per-round
         # VALUES live in ``chaos_schedule`` — which the service scheduler
         # rebinds per tenant lane, like data and the fault rates.
+        # Performance observability (telemetry.cost): None = no perf
+        # collection at all; PerfConfig = host-side-only cost/memory/
+        # timing capture. Unlike probes/sentinels/chaos this layer NEVER
+        # touches the trace — perf on and off compile byte-identical HLO
+        # (gate-enforced) — so "opt-in" here gates host work (an AOT
+        # compile detour, one block_until_ready per start() call), not
+        # program content.
+        self.perf: Optional[PerfConfig] = PerfConfig.coerce(perf)
+        self._cost_reports: list = []
+        self._perf_last: Optional[dict] = None
         self.chaos: Optional[ChaosConfig] = ChaosConfig.coerce(chaos)
         self.chaos_schedule = None
         self._chaos_edge_form: Optional[str] = None
@@ -1997,10 +2014,109 @@ class GossipSimulator(SimulationEventSender):
     def run_manifest(self, extra: Optional[dict] = None):
         """The once-per-run :class:`~gossipy_tpu.telemetry.RunManifest` for
         this simulator: config snapshot, backend/mesh/library versions,
-        git rev, :meth:`memory_budget`, and the last cold-compile wall
-        time. Host-side only — safe to call before or after a run."""
+        git rev, :meth:`memory_budget`, the last cold-compile wall
+        time and (with ``perf=`` on) the :meth:`perf_summary` block.
+        Host-side only — safe to call before or after a run."""
         from ..telemetry import RunManifest
         return RunManifest.from_simulator(self, extra=extra)
+
+    # -- performance observability (telemetry.cost; host-side only) ---------
+
+    def _record_cost(self, compiled, label: str,
+                     n_rounds: Optional[int] = None) -> None:
+        """Bank XLA's cost/memory analysis of one freshly compiled round
+        program (perf ``cost`` facility). Best-effort: a capture failure
+        must never take down a compile."""
+        try:
+            self._cost_reports.append(
+                CostReport.from_compiled(compiled, label=label,
+                                         n_rounds=n_rounds))
+        except Exception:
+            pass
+
+    def _perf_flops_per_round(self) -> Optional[float]:
+        """Per-round FLOPs from the latest banked program (XLA counts a
+        scan body once regardless of trip count, so a program's count IS
+        its per-round count)."""
+        for cr in reversed(self._cost_reports):
+            if cr.flops is not None:
+                return cr.flops
+        return None
+
+    def _attach_perf_stats(self, stats: dict, n_rounds: int,
+                           exec_seconds: float, cold: bool) -> dict:
+        """Stamp the run's host-measured timing into the stats dict as
+        per-round ``perf_*`` rows (uniform within this start() segment —
+        a scanned program has no per-round host boundary; chunked
+        drivers get per-chunk resolution) and remember the summary for
+        :meth:`perf_summary`."""
+        import jax as _jax
+        per_round_s = exec_seconds / max(n_rounds, 1)
+        flops_pr = self._perf_flops_per_round()
+        try:
+            kind = _jax.devices()[0].device_kind
+        except Exception:
+            kind = None
+        mfu = mfu_estimate(flops_pr, per_round_s, kind)
+        stats["perf_round_ms"] = np.full((n_rounds,), per_round_s * 1e3,
+                                         np.float64)
+        stats["perf_mfu_est"] = np.full(
+            (n_rounds,), np.nan if mfu is None else mfu, np.float32)
+        self._perf_last = {
+            "rounds": n_rounds,
+            "seconds": exec_seconds,
+            "ms_per_round": per_round_s * 1e3,
+            "mfu_est": mfu,
+            "flops_per_round": flops_pr,
+            # A cold NON-AOT dispatch folds compile time into the
+            # measurement; the AOT perf path compiles before the timer.
+            "cold": bool(cold),
+        }
+        return stats
+
+    def perf_summary(self) -> Optional[dict]:
+        """The manifest/verdict ``perf`` block (None when ``perf=`` is
+        off): banked program costs, the analytic cross-check, the last
+        run's timing/MFU, and the peak-table context. Every field is
+        null-safe — a CPU run reports real FLOPs/bytes with a null MFU
+        (unknown peak) rather than inventing one."""
+        if self.perf is None:
+            return None
+        from ..telemetry.cost import analytic_round_cost, peak_flops
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = None
+        latest = None
+        for cr in reversed(self._cost_reports):
+            if cr.flops is not None or cr.peak_bytes is not None:
+                latest = cr
+                break
+        analytic = None
+        if self.perf.analytic:
+            try:
+                analytic = analytic_round_cost(self)
+            except Exception:
+                analytic = None
+        hbm_candidates = [cr.peak_bytes for cr in self._cost_reports
+                          if cr.peak_bytes is not None]
+        out: dict = {
+            "config": self.perf.to_dict(),
+            "device_kind": kind,
+            "peak_flops": peak_flops(kind),
+            "compile_count": len(self._cost_reports),
+            "flops_per_round_xla": latest.flops if latest else None,
+            "bytes_per_round_xla": (latest.bytes_accessed
+                                    if latest else None),
+            "hbm_peak_bytes": max(hbm_candidates, default=None),
+            "analytic": analytic,
+            "last_run": self._perf_last,
+            "programs": [cr.to_dict() for cr in self._cost_reports],
+        }
+        if analytic and latest and latest.flops:
+            out["analytic_vs_xla_flops_ratio"] = float(
+                analytic["flops_per_round"] / latest.flops)
+        return out
 
     # -- persistence (API parity with reference simul.py:460-494) -----------
 
@@ -2137,39 +2253,76 @@ class GossipSimulator(SimulationEventSender):
         cache_k = ("start", n_rounds, self._cache_salt(), live,
                    bool(donate_state))
         cold = cache_k not in self._jit_cache
-        if cold:
-            self._jit_cache[cache_k] = jax.jit(
-                self._make_run(n_rounds, live),
-                donate_argnums=(0,) if donate_state else ())
 
         import time as _time
+        args = (state, key, self.data)
+        if self.sentinels is not None:
+            hc_in = (self._health_carry if self._health_carry is not None
+                     else self._health_zero_carry())
+            args = args + (hc_in,)
+        compile_recorded = False
+        if cold:
+            fn = jax.jit(self._make_run(n_rounds, live),
+                         donate_argnums=(0,) if donate_state else ())
+            if self.perf is not None and self.perf.cost:
+                # AOT detour: compile the SAME program explicitly so
+                # XLA's own cost_analysis/memory_analysis can be banked
+                # at compile time (telemetry.cost.CostReport). Falls back
+                # to plain dispatch-jit if the backend resists AOT.
+                t_c0 = _time.perf_counter()
+                try:
+                    compiled = fn.lower(*args).compile()
+                except Exception as e:
+                    import warnings
+                    warnings.warn("perf cost capture: AOT compile failed "
+                                  f"({e!r}); falling back to dispatch jit "
+                                  "(no CostReport for this program)")
+                    self._jit_cache[cache_k] = fn
+                else:
+                    self.last_compile_seconds = _time.perf_counter() - t_c0
+                    compile_recorded = True
+                    self._record_cost(compiled,
+                                      label=f"start[{n_rounds}r]"
+                                            f"{'/live' if live else ''}",
+                                      n_rounds=n_rounds)
+                    self._jit_cache[cache_k] = compiled
+            else:
+                self._jit_cache[cache_k] = fn
+
         # Live runs get host wall-clock samples per round boundary (the
         # ordered io_callback already syncs the host there, so the extra
         # perf_counter is free); non-live runs have no per-round host
         # boundary and skip timing rather than invent one.
         self._live_round_times: Optional[list] = [] if live else None
         t_run0 = _time.perf_counter()
-        args = (state, key, self.data)
-        if self.sentinels is not None:
-            hc_in = (self._health_carry if self._health_carry is not None
-                     else self._health_zero_carry())
-            args = args + (hc_in,)
         if profile_dir is not None:
             with jax.profiler.trace(profile_dir):
                 out = self._jit_cache[cache_k](*args)
                 jax.block_until_ready(out[0].model.params)
         else:
             out = self._jit_cache[cache_k](*args)
+        perf_timing = self.perf is not None and self.perf.timing
+        if perf_timing:
+            # ONE host sync per start() call (not per round): the measured
+            # wall time is this segment's whole-run cost, amortized to
+            # ms/round below. On a cold non-AOT dispatch the measurement
+            # would fold compile time in — flagged via "cold".
+            jax.block_until_ready(out)
+            exec_seconds = _time.perf_counter() - t_run0
         if self.sentinels is not None:
             state, self._health_carry, stats = out
         else:
             state, stats = out
-        if cold:
+        if cold and not compile_recorded:
             # Wall time of the cold dispatch: tracing + XLA compilation
             # (execution is async-dispatched and largely excluded, except
             # under profile_dir where the block_until_ready above folds the
-            # run in). Recorded for the RunManifest.
+            # run in). Recorded for the RunManifest. (The perf AOT path
+            # above already recorded the exact compile wall instead.)
             self.last_compile_seconds = _time.perf_counter() - t_run0
+        if perf_timing:
+            stats = self._attach_perf_stats(dict(stats), n_rounds,
+                                            exec_seconds, cold)
         # Building the report forces the stats device->host transfer, which
         # completes only after the program (including its ordered callbacks)
         # finishes — harvest the live timestamps only after that, or the
@@ -2195,6 +2348,7 @@ class GossipSimulator(SimulationEventSender):
         extras = {k: opt(k) for k in PROBE_STAT_KEYS if k in stats}
         extras.update({k: opt(k) for k in HEALTH_STAT_KEYS if k in stats})
         extras.update({k: opt(k) for k in CHAOS_PROBE_KEYS if k in stats})
+        extras.update({k: opt(k) for k in PERF_STAT_KEYS if k in stats})
         if self.probes is not None:
             if self.probes.consensus:
                 extras["probe_layer_names"] = self._probe_layer_names()
@@ -2270,7 +2424,8 @@ class GossipSimulator(SimulationEventSender):
 
         cache_k = ("reps", n_rounds, bool(local_train), bool(common_init),
                    self._cache_salt())
-        if cache_k not in self._jit_cache:
+        cold_reps = cache_k not in self._jit_cache
+        if cold_reps:
             def one(key):
                 k_init, k_run = jax.random.split(key)
                 st = self.init_nodes(k_init, local_train=local_train,
@@ -2307,6 +2462,22 @@ class GossipSimulator(SimulationEventSender):
         saved_axis = self._batch_axis_name
         self._batch_axis_name = BATCH_AXIS
         try:
+            if cold_reps and self.perf is not None and self.perf.cost:
+                # Same AOT cost-capture detour as start(): the seed-batch
+                # program's own cost/memory analysis is banked at compile
+                # time (traced HERE so the batch-axis pmax sees the axis).
+                try:
+                    compiled = self._jit_cache[cache_k].lower(
+                        keys).compile()
+                except Exception:
+                    pass  # dispatch jit still runs; no CostReport
+                else:
+                    self._record_cost(
+                        compiled,
+                        label=f"run_repetitions[{n_rounds}r"
+                              f"x{int(keys.shape[0])}]",
+                        n_rounds=n_rounds)
+                    self._jit_cache[cache_k] = compiled
             states, stats = self._jit_cache[cache_k](keys)
         finally:
             self._batch_axis_name = saved_axis

@@ -93,6 +93,16 @@ class SimulationEventReceiver:
         scheduled-fault failure cause). Fired after ``update_health``,
         live and replayed alike."""
 
+    def update_perf(self, round: int, perf: dict) -> None:
+        """Per-round performance stats (fired only by runs with ``perf=``
+        enabled; see :mod:`gossipy_tpu.telemetry.cost`). ``perf`` carries
+        the JSON-able row — subsets of ``round_ms`` (host-measured wall
+        ms, uniform within one ``start()`` segment) and ``mfu_est``
+        (null off known accelerators). The values are HOST-derived after
+        the segment finishes, so — unlike the probe/health/chaos rows —
+        they replay only (live receivers saw the round before its timing
+        existed). Fired after ``update_chaos``."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""
@@ -133,7 +143,8 @@ class SimulationEventSender:
                       causes: Optional[dict] = None,
                       probes: Optional[dict] = None,
                       health: Optional[dict] = None,
-                      chaos: Optional[dict] = None) -> None:
+                      chaos: Optional[dict] = None,
+                      perf: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
@@ -148,6 +159,8 @@ class SimulationEventSender:
                 r.update_health(round, health)
             if chaos is not None:
                 r.update_chaos(round, chaos)
+            if perf is not None:
+                r.update_perf(round, perf)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -182,6 +195,7 @@ class SimulationEventSender:
                           for c in ("drop", "offline", "overflow")}
             if "failed_chaos" in stats:
                 cause_arrs["chaos"] = np.asarray(stats["failed_chaos"])
+        from ..telemetry.cost import PERF_STAT_KEYS, perf_event_row
         from ..telemetry.health import HEALTH_STAT_KEYS, health_event_row
         from ..telemetry.probes import PROBE_STAT_KEYS, probe_event_row
         from .faults import CHAOS_PROBE_KEYS, chaos_event_row
@@ -192,6 +206,8 @@ class SimulationEventSender:
         chaos_arrs = {k: np.asarray(stats[k])
                       for k in ("failed_chaos",) + CHAOS_PROBE_KEYS
                       if k in stats}
+        perf_arrs = {k: np.asarray(stats[k]) for k in PERF_STAT_KEYS
+                     if k in stats}
 
         def row(arr, i):
             vals = arr[i]
@@ -206,11 +222,13 @@ class SimulationEventSender:
             health = health_event_row(
                 {k: a[i] for k, a in health_arrs.items()})
             chaos = chaos_event_row({k: a[i] for k, a in chaos_arrs.items()})
+            perf = perf_event_row({k: a[i] for k, a in perf_arrs.items()})
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
                                include_live=include_live, causes=causes,
-                               probes=probes, health=health, chaos=chaos)
+                               probes=probes, health=health, chaos=chaos,
+                               perf=perf)
         if fire_end:
             self._notify_end()
 
@@ -305,6 +323,9 @@ class CallbackReceiver(SimulationEventReceiver):
     def update_chaos(self, round, chaos):
         self._row["chaos"] = dict(chaos)
 
+    def update_perf(self, round, perf):
+        self._row["perf"] = dict(perf)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = dict(metrics)
 
@@ -318,7 +339,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     any dashboard can tail the .jsonl (for a push-style sink — W&B,
     TensorBoard — use :class:`CallbackReceiver` instead).
 
-    Line schema (``"schema": 4``), one object per round — versions are
+    Line schema (``"schema": 6``), one object per round — versions are
     strictly additive, so a reader written against any version parses
     every later one by ignoring unknown keys (and every earlier one via
     :meth:`parse_line`, which fills absent fields with null):
@@ -361,6 +382,15 @@ class JSONLinesReceiver(SimulationEventReceiver):
                                     ``ChaosConfig`` (null without
                                     ``chaos=``; ``failed_by_cause`` also
                                     gains a ``chaos`` key on such runs)
+        v6      ``perf``            performance row ``| null``: subsets
+                                    of ``round_ms`` (host-measured wall
+                                    ms, uniform within one ``start()``
+                                    segment) and ``mfu_est`` per the
+                                    run's ``PerfConfig`` (null without
+                                    ``perf=``; replay-only — a live
+                                    stream writes null here because the
+                                    timing is host-derived after the
+                                    segment)
         ======= =================== =====================================
 
     Works replayed (default) or live (``live=True`` streams rows during the
@@ -373,7 +403,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
-    SCHEMA = 5
+    SCHEMA = 6
 
     def __init__(self, path: str, live: bool = False):
         import json
@@ -387,7 +417,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
         self._row = {"schema": self.SCHEMA, "round": round, "sent": sent,
                      "failed": failed, "failed_by_cause": None,
                      "size": size, "probes": None, "health": None,
-                     "chaos": None, "local": None, "global": None}
+                     "chaos": None, "perf": None, "local": None,
+                     "global": None}
 
     def update_failure_causes(self, round, causes):
         self._row["failed_by_cause"] = dict(causes)
@@ -401,6 +432,9 @@ class JSONLinesReceiver(SimulationEventReceiver):
     def update_chaos(self, round, chaos):
         self._row["chaos"] = dict(chaos)
 
+    def update_perf(self, round, perf):
+        self._row["perf"] = dict(perf)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics
 
@@ -412,7 +446,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     @classmethod
     def parse_line(cls, line: str) -> dict:
-        """Version-tolerant row reader: normalize a v1..v5 line into
+        """Version-tolerant row reader: normalize a v1..v6 line into
         the CURRENT schema's shape (fields a line's version predates come
         back null, unknown future fields pass through untouched). The one
         reader consumers should use instead of re-encoding the version
@@ -428,6 +462,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
             row.setdefault("health", None)
         if schema < 5:
             row.setdefault("chaos", None)
+        if schema < 6:
+            row.setdefault("perf", None)
         return row
 
     def close(self):

@@ -46,8 +46,10 @@ import numpy as np
 # 3: + gossip-dynamics probe arrays (probe_*) and the static probe context;
 # 4: + numerics-sentinel health arrays (health_*; telemetry.health);
 # 5: + scheduled-fault chaos arrays (chaos_*; simulation.faults) and the
-#    optional "chaos" key in failed_per_cause.
-REPORT_SCHEMA = 5
+#    optional "chaos" key in failed_per_cause;
+# 6: + performance arrays (perf_*; telemetry.cost) — host-measured
+#    ms/round and the per-round MFU estimate.
+REPORT_SCHEMA = 6
 
 # Optional per-round arrays (attribute name == JSON key), concatenated
 # along axis 0 by :meth:`SimulationReport.concatenate` (surviving only
@@ -85,6 +87,11 @@ PER_ROUND_FIELDS = (
     "chaos_within_mean",             # [R] f32: mean distance of nodes from
                                      # their own component's mean
     "chaos_active_components",       # [R] i32: non-empty components
+    "perf_round_ms",                 # [R] f64: host-measured wall ms per
+                                     # round (uniform within one start()
+                                     # segment; perf= runs only)
+    "perf_mfu_est",                  # [R] f32: flops/round vs the chip
+                                     # peak (NaN off known accelerators)
     "wall_clock_seconds_per_round",  # [R] f64 (live runs only)
 )
 

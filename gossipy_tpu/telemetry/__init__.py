@@ -23,9 +23,28 @@ other way, so everything here is importable standalone):
   watermarks) the engines compute when ``sentinels=`` is set, plus the
   anomaly-triggered :class:`FlightRecorder` and its
   :func:`replay_bundle` deterministic-replay counterpart.
+- :mod:`.cost` — :class:`PerfConfig` and the host-side performance
+  observability layer (``perf=``): per-compiled-program
+  :class:`CostReport` (XLA cost/memory analysis), the analytic
+  per-round estimate, MFU against :data:`PEAK_FLOPS`, and per-phase
+  time attribution. Never touches the trace — perf on/off compile
+  byte-identical HLO.
 """
 
 from .causes import FAILURE_CAUSES, FailureCounts
+from .cost import (
+    PEAK_FLOPS,
+    PERF_STAT_KEYS,
+    CostReport,
+    PerfConfig,
+    analytic_round_cost,
+    cost_report_for,
+    differential_phase_attribution,
+    mfu_estimate,
+    peak_flops,
+    perf_event_row,
+    phase_times_from_trace,
+)
 from .health import (
     BUNDLE_VERSION,
     HEALTH_STAT_KEYS,
@@ -75,4 +94,8 @@ __all__ = [
     "FlightRecorder", "health_event_row", "health_round_stats",
     "localize_first_nonfinite", "nonfinite_counts", "nonfinite_total",
     "per_node_param_norm", "replay_bundle",
+    "PerfConfig", "CostReport", "PEAK_FLOPS", "PERF_STAT_KEYS",
+    "analytic_round_cost", "cost_report_for",
+    "differential_phase_attribution", "mfu_estimate", "peak_flops",
+    "perf_event_row", "phase_times_from_trace",
 ]

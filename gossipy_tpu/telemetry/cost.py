@@ -1,0 +1,642 @@
+"""Performance observability: cost/memory introspection, MFU, attribution.
+
+The correctness-facing observability stack (causes, probes, sentinels,
+chaos vitals) says WHAT a run computed; this module says what it COST.
+Four host-side pillars, all opt-in at runtime and — like every opt-in
+layer in this repo — strictly HLO-neutral: nothing here ever touches the
+traced program, so ``perf=None`` (the default) and ``perf=True`` compile
+byte-identical HLO (gate-enforced in ``scripts/hlo_gate.py``).
+
+- **Per-program cost capture** (:class:`CostReport`): when a simulator is
+  built with ``perf=``, every round program it compiles goes through the
+  AOT path (``jax.jit(...).lower(...).compile()``) and XLA's own
+  ``cost_analysis()`` (FLOPs, bytes accessed) and ``memory_analysis()``
+  (argument / output / temp / alias / generated-code bytes) are banked at
+  compile time. The same capture backs ``bench.py --mfu`` and the
+  scale-ladder forensics, so a crash at large N names the failing
+  program's memory numbers instead of losing them with the traceback.
+- **Analytic cost model** (:func:`analytic_round_cost`): a model-side
+  per-round FLOP/byte estimate derived from the configuration — the
+  handler's local-update program is counted at the jaxpr level
+  (dot/conv dominant terms, :func:`jaxpr_flops`) and composed with the
+  engine's merge and eval geometry. CPU runs therefore still produce a
+  model-side number, and the two counters cross-check each other
+  (``analytic_vs_xla_flops_ratio`` in the ``perf`` manifest block).
+- **MFU** (:func:`mfu_estimate` against :data:`PEAK_FLOPS`, the peak
+  table ``bench.py`` now consumes from here): per-round measured wall
+  time vs the chip's bf16 dense-matmul peak. The FLOP numerator follows
+  XLA's counting convention (a ``fori_loop``/``scan`` body is counted
+  ONCE regardless of trip count — the deliver loop executes per occupied
+  mailbox slot), so the quoted MFU is *conservative*: throughput against
+  the canonical counted workload, not a hardware FLOP counter
+  (docs/performance.md).
+- **Phase attribution** (:func:`differential_phase_attribution` /
+  :func:`phase_times_from_trace`): wall time attributed to the
+  ``jax.named_scope`` round phases — from an XProf/perfetto trace when
+  profiling is on (the parser reduces the dumped trace to per-phase ms),
+  or from structural differencing (eval toggled, one epoch isolated) as
+  the host-timer fallback. ``scripts/profile_round.py`` is the CLI
+  surface.
+
+Like the rest of :mod:`gossipy_tpu.telemetry`, nothing here imports the
+engines — the dependency points the other way.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+# Peak dense matmul throughput per chip, by PJRT device_kind. MFU is
+# quoted against the bf16 MXU peak (the rate the CNN config's convs run
+# at with bf16 compute); fp32 configs on TPU still route through the MXU
+# via multi-pass bf16, so the bf16 peak stays the honest denominator.
+# (Moved here from bench.py — ONE definition for bench rows, manifests
+# and the scale ladder.)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e: 197 bf16 TFLOP/s per chip
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+}
+
+
+def peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
+    """The chip's peak FLOP/s from :data:`PEAK_FLOPS`, or None for
+    unknown kinds (CPU hosts, new chips — MFU is then null, never a
+    made-up number). ``device_kind`` defaults to the current backend's
+    first device."""
+    if device_kind is None:
+        import jax
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    return PEAK_FLOPS.get(device_kind)
+
+
+def mfu_estimate(flops_per_round: Optional[float],
+                 seconds_per_round: Optional[float],
+                 device_kind: Optional[str] = None) -> Optional[float]:
+    """Model-FLOPs-utilization: achieved FLOP/s over the chip's peak.
+    None whenever any input is unknown (no FLOP count, no timing, no
+    peak for this device kind)."""
+    if not flops_per_round or not seconds_per_round:
+        return None
+    peak = peak_flops(device_kind)
+    if not peak:
+        return None
+    return float(flops_per_round / seconds_per_round / peak)
+
+
+@dataclass(frozen=True)
+class PerfConfig:
+    """Which performance-observability facilities a simulator runs.
+
+    - ``cost``: capture a :class:`CostReport` (XLA ``cost_analysis`` +
+      ``memory_analysis``) for every round program the simulator
+      compiles (routes compilation through the AOT path — the compiled
+      program is identical, the executable object is just held long
+      enough to read its own cost model).
+    - ``analytic``: compute the model-side per-round estimate
+      (:func:`analytic_round_cost`) and the cross-check ratio for the
+      manifest ``perf`` block.
+    - ``timing``: per-run wall timing (adds ONE host sync per
+      ``start()`` call — not per round) stamped as ``perf_round_ms`` /
+      ``perf_mfu_est`` report rows and ``update_perf`` events.
+    """
+
+    cost: bool = True
+    analytic: bool = True
+    timing: bool = True
+
+    @classmethod
+    def coerce(cls, perf: Union[None, bool, "PerfConfig"]
+               ) -> Optional["PerfConfig"]:
+        """Normalize the ``perf=`` constructor argument: ``None``/
+        ``False`` → off (None), ``True`` → everything at defaults, a
+        :class:`PerfConfig` → itself (None when every facility is
+        off)."""
+        if perf is None or perf is False:
+            return None
+        if perf is True:
+            return cls()
+        if isinstance(perf, cls):
+            if not (perf.cost or perf.analytic or perf.timing):
+                return None
+            return perf
+        raise TypeError("perf= expects None, bool or PerfConfig; got "
+                        f"{type(perf).__name__}")
+
+    def to_dict(self) -> dict:
+        return {"cost": self.cost, "analytic": self.analytic,
+                "timing": self.timing}
+
+
+@dataclass
+class CostReport:
+    """XLA's own account of one compiled program, banked at compile time.
+
+    ``flops`` / ``bytes_accessed`` come from ``cost_analysis()`` (the HLO
+    cost model: loop bodies counted once, conds priced at the larger
+    branch); the ``*_bytes`` fields from ``memory_analysis()``. Any field
+    an older jax or an exotic backend cannot produce is None — a capture
+    failure must never take down a compile.
+    """
+
+    label: str
+    n_rounds: Optional[int] = None
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def peak_bytes(self) -> Optional[int]:
+        """Approximate execution-time device-memory peak: live arguments
+        + outputs + XLA temporaries, minus the aliased (donated) overlap.
+        A floor on the true peak (allocator slack excluded), but the
+        number that says WHICH program blew up at scale."""
+        parts = (self.argument_bytes, self.output_bytes, self.temp_bytes)
+        if any(p is None for p in parts):
+            return None
+        return int(sum(parts) - (self.alias_bytes or 0))
+
+    @classmethod
+    def from_compiled(cls, compiled: Any, label: str,
+                      n_rounds: Optional[int] = None) -> "CostReport":
+        """Read ``cost_analysis()`` + ``memory_analysis()`` off a
+        ``jax.stages.Compiled``. Best-effort field by field."""
+        cr = cls(label=label, n_rounds=n_rounds)
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+                cost = cost[0]
+            f = float(cost.get("flops", float("nan")))
+            cr.flops = f if math.isfinite(f) else None
+            b = float(cost.get("bytes accessed", float("nan")))
+            cr.bytes_accessed = b if math.isfinite(b) else None
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            for attr, key in (("argument_size_in_bytes", "argument_bytes"),
+                              ("output_size_in_bytes", "output_bytes"),
+                              ("temp_size_in_bytes", "temp_bytes"),
+                              ("alias_size_in_bytes", "alias_bytes"),
+                              ("generated_code_size_in_bytes",
+                               "generated_code_bytes")):
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    setattr(cr, key, int(v))
+        except Exception:
+            pass
+        return cr
+
+    def to_dict(self) -> dict:
+        out = {
+            "label": self.label,
+            "n_rounds": self.n_rounds,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "peak_bytes": self.peak_bytes,
+        }
+        if self.extra:
+            out["extra"] = self.extra
+        return out
+
+
+def cost_report_for(sim, state=None, key=None, n_rounds: int = 1,
+                    label: Optional[str] = None) -> Optional[CostReport]:
+    """AOT-compile the simulator's ``n_rounds`` round program and read
+    its :class:`CostReport` — the shared helper behind ``bench.py``'s
+    FLOP counting and the scale ladder's per-rung capture. XLA's HLO
+    cost model counts a scan body ONCE regardless of trip count
+    (verified: 1-round and 10-round programs report equal flops), so a
+    1-round program gives per-round FLOPs directly. Returns None when
+    the backend cannot lower/compile AOT."""
+    import jax
+    if key is None:
+        key = jax.random.PRNGKey(42)
+    if state is None:
+        state = sim.init_nodes(key)
+    try:
+        compiled = sim.lower_start(state, n_rounds=n_rounds,
+                                   key=key).compile()
+    except Exception:
+        return None
+    return CostReport.from_compiled(
+        compiled, label or f"{type(sim).__name__}[{n_rounds}r]",
+        n_rounds=n_rounds)
+
+
+# -- analytic cost model ----------------------------------------------------
+
+
+def jaxpr_flops(jaxpr: Any) -> float:
+    """Trace-level FLOP count of a (closed or open) jaxpr: ``dot_general``
+    and ``conv_general_dilated`` dominant terms, recursing through
+    call/scan/while/cond sub-jaxprs (scan bodies multiply by the trip
+    count; while bodies count once; cond prices the LARGER branch —
+    matching XLA's convention so the two counters stay comparable).
+    Elementwise ops are deliberately excluded: this is a dominant-term
+    estimate, not a second HLO cost model."""
+    if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += _dot_general_flops(eqn)
+            continue
+        if name == "conv_general_dilated":
+            total += _conv_flops(eqn)
+            continue
+        p = eqn.params
+        if "branches" in p:  # cond / switch: larger branch, like XLA
+            total += max((jaxpr_flops(b) for b in p["branches"]),
+                         default=0.0)
+            continue
+        mult = 1.0
+        subs = []
+        if "jaxpr" in p:
+            subs.append(p["jaxpr"])
+            if name == "scan":
+                mult = float(p.get("length", 1))
+        for k in ("call_jaxpr", "body_jaxpr", "cond_jaxpr"):
+            if k in p:
+                subs.append(p[k])
+        for sub in subs:
+            total += mult * jaxpr_flops(sub)
+    return total
+
+
+def _dot_general_flops(eqn) -> float:
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    batch = float(np.prod([lhs.shape[i] for i in lb], dtype=np.float64)) \
+        if lb else 1.0
+    contract = float(np.prod([lhs.shape[i] for i in lc],
+                             dtype=np.float64)) if lc else 1.0
+    m = float(np.prod([lhs.shape[i] for i in range(lhs.ndim)
+                       if i not in lb and i not in lc], dtype=np.float64))
+    rb_set, rc_set = set(_rb), set(rc)
+    n = float(np.prod([rhs.shape[i] for i in range(rhs.ndim)
+                       if i not in rb_set and i not in rc_set],
+                      dtype=np.float64))
+    return 2.0 * batch * m * n * contract
+
+
+def _conv_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval
+    dn = eqn.params["dimension_numbers"]
+    # rhs_spec = (out_feature_dim, in_feature_dim, *spatial); the kernel's
+    # in-feature dim is already per-group under feature_group_count.
+    o_dim, i_dim, *spatial = dn.rhs_spec
+    k_spatial = float(np.prod([rhs.shape[d] for d in spatial],
+                              dtype=np.float64)) if spatial else 1.0
+    in_feat = float(rhs.shape[i_dim])
+    return 2.0 * float(np.prod(out.shape, dtype=np.float64)) \
+        * k_spatial * in_feat
+
+
+def _param_count(params) -> int:
+    import jax
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(params))
+
+
+def analytic_round_cost(sim) -> Optional[dict]:
+    """Model-side per-round FLOP/byte estimate for a simulator, derived
+    from its configuration: the handler's local-update program is
+    counted at the jaxpr level (:func:`jaxpr_flops`, one node's data
+    shapes) and composed with the engine's geometry — merge math per
+    delivered message, the evaluation passes, the history-ring wire
+    traffic.
+
+    Two FLOP figures are reported because XLA's cost model counts the
+    deliver ``fori_loop`` body ONCE while it executes per occupied
+    mailbox slot:
+
+    - ``flops_per_round`` follows the counted-once convention (ONE
+      deliver pass) — directly comparable to a compiled round program's
+      ``cost_analysis()["flops"]``;
+    - ``flops_per_round_executed`` scales the deliver pass by the
+      topology's mean expected fan-in (clipped to the mailbox capacity)
+      and amortizes evaluation over ``eval_every`` — the honest
+      executed-work estimate behind the conservative-MFU caveat (it can
+      sit on either side of the counted figure: more deliver passes,
+      fewer eval passes).
+
+    Returns None when the handler resists shape-only tracing (exotic
+    variants) — an estimate failure must never take down a run.
+    """
+    import jax
+
+    try:
+        st = jax.eval_shape(sim.handler.init, jax.random.PRNGKey(0))
+        P = _param_count(st.params)
+        n = sim.n_nodes
+        xtr, ytr, mtr = sim._local_data()
+        one = tuple(jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                    for a in (xtr, ytr, mtr))
+        key = jax.random.PRNGKey(0)
+        upd = jax.make_jaxpr(
+            lambda s, d, k: sim.handler.update(s, d, k))(st, one, key)
+        train_per_node = jaxpr_flops(upd)
+    except Exception:
+        return None
+
+    # Merge math per delivered message: a leafwise blend of two param
+    # sets plus fp32 widening — ~4 FLOPs per scalar is the dominant term
+    # for every in-tree merge variant.
+    merge_per_msg = 4.0 * P
+    deliver_pass = float(n) * (train_per_node + merge_per_msg)
+
+    # Expected occupied mailbox slots per round (mean expected fan-in
+    # under the topology, clipped into [1, K]): the executed-work
+    # multiplier the counted-once convention drops.
+    K = int(getattr(sim, "K", 1))
+    try:
+        lam_mean = float(np.mean(sim._lam_vector()))
+    except Exception:
+        lam_mean = 1.0
+    passes_exec = min(max(lam_mean, 1.0), float(max(K, 1)))
+
+    # Evaluation: forward passes over the configured test sets, counted
+    # from the handler's own evaluate program on the real shapes.
+    eval_flops = 0.0
+    try:
+        n_eval_nodes = (sim._n_eval_nodes()
+                        if getattr(sim, "sampling_eval", 0) > 0 else n)
+    except Exception:
+        n_eval_nodes = n
+    import jax.numpy as jnp
+    for want, keys in ((getattr(sim, "has_local_test", False),
+                        ("xte", "yte", "mte")),
+                       (getattr(sim, "has_global_eval", False),
+                        ("x_eval", "y_eval", None))):
+        if not want:
+            continue
+        try:
+            x = sim.data[keys[0]]
+            y = sim.data[keys[1]]
+            if keys[2] is not None:  # per-node local test shards
+                x, y = x[0], y[0]
+                m = sim.data[keys[2]][0]
+            else:
+                m = jnp.ones(x.shape[0], jnp.float32)
+            d = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in (x, y, m))
+            ev = jax.make_jaxpr(
+                lambda s, dd: sim.handler.evaluate(s, dd))(st, d)
+            eval_flops += n_eval_nodes * jaxpr_flops(ev)
+        except Exception:
+            continue
+
+    # Counted convention: XLA prices the eval_every lax.cond at its
+    # LARGER branch, so the comparable figure carries the FULL eval pass
+    # every round; the executed estimate amortizes it over eval_every
+    # (and scales the deliver pass by expected occupancy) — the two can
+    # land on either side of each other, which is exactly the honesty
+    # the caveat documents.
+    eval_every = float(getattr(sim, "eval_every", 1) or 1)
+    flops_counted = deliver_pass + eval_flops
+    flops_executed = deliver_pass * passes_exec + eval_flops / eval_every
+
+    # Bytes per round, dominant terms: the history-ring gather traffic
+    # (one wire message per expected delivery), params read+write, and
+    # one epoch's training-data read.
+    bytes_pr = None
+    try:
+        wire = sim.wire_bytes_per_message()
+        epochs = float(getattr(sim.handler, "local_epochs", 1) or 1)
+        data_read = epochs * sum(
+            float(np.prod(a.shape[1:])) * np.dtype(a.dtype).itemsize
+            for a in (xtr,)) * n
+        bytes_pr = float(n) * (lam_mean * wire + 2.0 * 4.0 * P) + data_read
+    except Exception:
+        pass
+
+    return {
+        "flops_per_round": flops_counted,
+        "flops_per_round_executed": flops_executed,
+        "bytes_per_round": bytes_pr,
+        "train_flops_per_node": train_per_node,
+        "merge_flops_per_message": merge_per_msg,
+        "eval_flops_per_round": eval_flops,
+        "expected_deliver_passes": passes_exec,
+        "param_count": P,
+        "note": "jaxpr-level dominant terms (dot/conv); counted-once "
+                "convention for flops_per_round, executed estimate "
+                "scales the deliver pass by expected fan-in",
+    }
+
+
+# -- per-round perf stats (report schema 6 / update_perf events) ------------
+
+# Per-round perf stat keys the engines attach host-side after a timed
+# run (and the report/event layers consume) — same registry discipline
+# as PROBE_STAT_KEYS / HEALTH_STAT_KEYS. Host-derived (there is no
+# per-round device boundary in a scanned program), so the per-round
+# value is the run's amortized ms/round, uniform within one start()
+# call; chunked drivers get per-chunk resolution for free.
+PERF_STAT_KEYS = (
+    "perf_round_ms",
+    "perf_mfu_est",
+)
+
+
+def perf_event_row(vals: dict) -> Optional[dict]:
+    """The per-round ``update_perf`` observer payload (JSON-able
+    scalars) from one round's perf values — absent facilities are simply
+    absent keys. Returns None when ``vals`` carries no perf stat."""
+    if not vals:
+        return None
+    row: dict = {}
+    if "perf_round_ms" in vals:
+        v = float(vals["perf_round_ms"])
+        row["round_ms"] = v if math.isfinite(v) else None
+    if "perf_mfu_est" in vals:
+        v = float(vals["perf_mfu_est"])
+        row["mfu_est"] = v if math.isfinite(v) else None
+    return row or None
+
+
+# -- phase attribution ------------------------------------------------------
+
+
+def differential_phase_attribution(make_sim: Callable[..., Any],
+                                   rounds: int,
+                                   key=None) -> dict:
+    """Host-timer phase attribution by structural differencing — the
+    fallback when no profiler trace is available (and the cross-check
+    when one is).
+
+    ``make_sim(**overrides)`` must build the simulator, honoring the
+    ``eval_every`` and ``local_epochs`` overrides. Three steady-state
+    timings are differenced: full round, evaluation structurally off
+    (``eval_every`` past the horizon), and a doubled local-epoch count
+    (the extra epoch's marginal cost isolates one epoch of training).
+    The exchange leg is defined as the remainder, so the three phases
+    sum to the full round time EXACTLY by construction — the 5%
+    acceptance band in the tests guards the arithmetic, not the noise.
+    """
+    import jax
+
+    def time_one(**overrides) -> float:
+        sim = make_sim(**overrides)
+        k = key if key is not None else jax.random.PRNGKey(42)
+        state = sim.init_nodes(k)
+        s2, _ = sim.start(state, n_rounds=rounds, key=k,
+                          donate_state=False)
+        jax.block_until_ready(s2.model.params)
+        import time as _time
+        t0 = _time.perf_counter()
+        s3, _ = sim.start(state, n_rounds=rounds, key=k)
+        jax.block_until_ready(s3.model.params)
+        return (_time.perf_counter() - t0) / rounds * 1e3
+
+    full = time_one()
+    no_eval = time_one(eval_every=10 * rounds)
+    two_epochs = time_one(eval_every=10 * rounds, local_epochs=2)
+    train = two_epochs - no_eval  # one epoch's marginal cost
+    return {
+        "method": "differential",
+        "full_ms": full,
+        "phases_ms": {
+            "eval": full - no_eval,
+            "train": train,
+            "exchange_and_overhead": no_eval - train,
+        },
+        "rounds": rounds,
+        "note": "steady-state differencing; at small round counts the "
+                "legs carry run-to-run noise and can go slightly "
+                "negative",
+    }
+
+
+def hlo_op_phases(hlo_text: str, phases=None) -> dict:
+    """Map compiled-HLO instruction names to the round phase named in
+    their ``op_name`` metadata (``jax.named_scope`` survives into it).
+    Bridges trace events to phases on backends whose JSON trace carries
+    bare HLO op names without metadata (the CPU runtime): pass the
+    result as ``op_to_phase`` to :func:`phase_times_from_trace`."""
+    import re
+    if phases is None:
+        from .scopes import ROUND_PHASES
+        phases = ROUND_PHASES
+    pat = re.compile(r"%([\w.\-]+) = .*?op_name=\"([^\"]*)\"")
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = pat.search(line)
+        if m is None:
+            continue
+        name, op = m.groups()
+        hit = _deepest_phase(op, phases)
+        if hit is not None:
+            out[name] = hit
+    return out
+
+
+def _deepest_phase(haystack: str, phases) -> Optional[str]:
+    """The phase whose scope name appears DEEPEST in a metadata path —
+    ``gossipy.train`` nests inside ``gossipy.receive_merge``/``reply``,
+    so an op inside the train scope must attribute to train, not to its
+    enclosing phase."""
+    best, pos = None, -1
+    for p in phases:
+        i = haystack.rfind(p)
+        if i > pos:
+            best, pos = p, i
+    return best
+
+
+def phase_times_from_trace(trace_dir: str,
+                           phases=None,
+                           op_to_phase: Optional[dict] = None
+                           ) -> Optional[dict]:
+    """Reduce a ``jax.profiler`` trace directory to per-phase
+    milliseconds: device-op durations are summed per
+    :data:`~gossipy_tpu.telemetry.scopes.ROUND_PHASES` name found in the
+    event metadata. Reads the perfetto/chrome JSON traces
+    (``*.json.gz`` — request one with ``jax.profiler.trace(dir,
+    create_perfetto_trace=True)``; this runtime also writes
+    ``*.trace.json.gz``). Events match a phase when the scope name
+    appears in their name/args metadata (XProf TPU dumps) or — pass
+    ``op_to_phase`` from :func:`hlo_op_phases` — when their bare HLO op
+    name maps to a phase through the compiled program's own metadata
+    (the CPU runtime's traces). Returns ``{phase: ms}`` for the phases
+    seen, or None when no parsable trace / no phase-tagged events exist
+    (the caller falls back to
+    :func:`differential_phase_attribution`)."""
+    import gzip
+    import json
+    import os
+
+    if phases is None:
+        from .scopes import ROUND_PHASES
+        phases = ROUND_PHASES
+
+    def one_file(path: str, gz: bool) -> Optional[dict]:
+        try:
+            if gz:
+                with gzip.open(path, "rt") as fh:
+                    doc = json.load(fh)
+            else:
+                with open(path) as fh:
+                    doc = json.load(fh)
+        except Exception:
+            return None
+        events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+            else doc
+        if not isinstance(events, list):
+            return None
+        sums = {p: 0.0 for p in phases}
+        found = False
+        for ev in events:
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
+                continue
+            dur = ev.get("dur")
+            if not dur:
+                continue
+            name = ev.get("name", "")
+            hay = name
+            args = ev.get("args")
+            if isinstance(args, dict):
+                hay += " " + " ".join(str(v) for v in args.values())
+            hit = _deepest_phase(hay, phases)
+            if hit is None and op_to_phase is not None:
+                hit = op_to_phase.get(name)
+            if hit is not None:
+                sums[hit] += float(dur)  # microseconds
+                found = True
+        if not found:
+            return None
+        return {p: v / 1e3 for p, v in sums.items() if v > 0.0}
+
+    # ONE file's account only: XProf mirrors the same events into
+    # several JSON dumps (perfetto_trace + <host>.trace), and summing
+    # across them would double-count every op.
+    for root, _, files in os.walk(trace_dir):
+        for fname in sorted(files):
+            if not (fname.endswith(".json.gz") or fname.endswith(".json")):
+                continue
+            result = one_file(os.path.join(root, fname),
+                              fname.endswith(".gz"))
+            if result is not None:
+                return result
+    return None

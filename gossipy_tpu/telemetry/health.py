@@ -50,7 +50,10 @@ Bundle directory schema (``BUNDLE_VERSION`` 1)::
                        (extra.flight_recorder carries the bundle block)
       verdict.json     {"bundle_version", "kind": "sentinel" | "exception"
                         | "watchdog", "chunk_start_round",
-                        "first_bad_round" | null, "detail": {...}}
+                        "first_bad_round" | null, "detail": {...},
+                        "perf": {last_round_ms, hbm_peak_bytes,
+                                 flops_per_round_xla, compile_count,
+                                 mfu_est} | null (perf= runs only)}
       events.jsonl     trailing telemetry events from the sink ring
                        (per-round rows the recorder mirrors in, plus any
                        engine diagnostics), oldest first
@@ -403,6 +406,10 @@ class FlightRecorder:
             "first_bad_round": (int(first_bad_round)
                                 if first_bad_round is not None else None),
             "detail": detail,
+            # Performance context of the failure (telemetry.cost): a
+            # dead-run bundle carries the last round's cost, not just
+            # its numerics. Null when the simulator runs without perf=.
+            "perf": _verdict_perf(sim),
         }
         with open(os.path.join(path, "verdict.json"), "w") as fh:
             json.dump(verdict, fh, indent=2)
@@ -521,6 +528,28 @@ class FlightRecorder:
         if bundle is None and self.bundle_path is not None:
             bundle = self.bundle_path  # watchdog fired mid-chunk
         return state, reports, bundle
+
+
+def _verdict_perf(sim) -> Optional[dict]:
+    """The bundle verdict's ``perf`` section: last-round ms, HBM peak and
+    compile counts from the simulator's perf layer (telemetry.cost) —
+    None when the run had ``perf=`` off, and best-effort always (the
+    perf context must never mask the failure being recorded)."""
+    try:
+        summary = (sim.perf_summary()
+                   if hasattr(sim, "perf_summary") else None)
+    except Exception:
+        return None
+    if summary is None:
+        return None
+    last = summary.get("last_run") or {}
+    return {
+        "last_round_ms": last.get("ms_per_round"),
+        "mfu_est": last.get("mfu_est"),
+        "hbm_peak_bytes": summary.get("hbm_peak_bytes"),
+        "flops_per_round_xla": summary.get("flops_per_round_xla"),
+        "compile_count": summary.get("compile_count"),
+    }
 
 
 def _trip_detail(sim, report, idx: int) -> dict:

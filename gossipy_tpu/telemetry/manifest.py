@@ -123,6 +123,12 @@ def _config_snapshot(sim: Any) -> dict:
         # cause and the chaos_* recovery vitals.
         chaos = sim.chaos
         snap["chaos"] = chaos.to_dict() if chaos is not None else None
+    if hasattr(sim, "perf"):
+        # The active PerfConfig (telemetry.cost) or None: whether this
+        # run banked program costs / timing (the collected numbers live
+        # in the manifest's top-level ``perf`` block, not here).
+        perf = sim.perf
+        snap["perf"] = perf.to_dict() if perf is not None else None
     return snap
 
 
@@ -151,6 +157,7 @@ class RunManifest:
     compile_seconds: Optional[float] = None
     compilation_cache: Optional[dict] = None
     telemetry_sink: Optional[dict] = None
+    perf: Optional[dict] = None
     created_at: float = field(default_factory=time.time)
     extra: dict = field(default_factory=dict)
     schema: int = MANIFEST_SCHEMA
@@ -195,6 +202,16 @@ class RunManifest:
                           "maxlen": sink.maxlen}
         except Exception:
             sink_stats = None
+        perf = None
+        if getattr(sim, "perf", None) is not None:
+            # The performance-observability block (telemetry.cost):
+            # banked program costs, the analytic cross-check, last-run
+            # timing/MFU. Null-safe on CPU (real FLOPs/bytes, null MFU)
+            # and best-effort — perf context must never kill the record.
+            try:
+                perf = sim.perf_summary()
+            except Exception:
+                perf = None
         config = _config_snapshot(sim)
         if config_overrides:
             config.update(config_overrides)
@@ -208,6 +225,7 @@ class RunManifest:
             compile_seconds=compile_seconds,
             compilation_cache=cache_stats,
             telemetry_sink=sink_stats,
+            perf=perf,
             extra=dict(extra or {}),
         )
 
@@ -224,6 +242,7 @@ class RunManifest:
             "compile_seconds": self.compile_seconds,
             "compilation_cache": self.compilation_cache,
             "telemetry_sink": self.telemetry_sink,
+            "perf": self.perf,
         }
         if self.extra:
             out["extra"] = self.extra

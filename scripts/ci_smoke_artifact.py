@@ -7,8 +7,9 @@ simulation under the flight recorder and writes, into ``--out DIR``:
   AND health arrays included; round-trips through
   ``SimulationReport.load``),
 - ``manifest.json`` — the run's :class:`RunManifest` (config, versions,
-  backend, memory budget, probes, sentinels, sink counters),
-- ``events.jsonl`` — the schema-v4 per-round JSONL rows,
+  backend, memory budget, probes, sentinels, sink counters, and the
+  ``perf`` block — XLA cost/memory numbers + timing, null-safe on CPU),
+- ``events.jsonl`` — the schema-v6 per-round JSONL rows,
 - ``bundle_*/`` — ONLY when the run trips a sentinel or raises: the
   flight-recorder repro bundle (checkpoint + manifest + verdict +
   trailing events), which the CI workflow uploads so a red smoke run
@@ -38,7 +39,7 @@ if _REPO not in sys.path:
 
 
 def build_smoke_sim(nodes: int = 16, probes: bool = True,
-                    sentinels: bool = True):
+                    sentinels: bool = True, perf: bool = True):
     """The CI smoke configuration, factored out so
     ``scripts/replay_bundle.py --demo`` can rebuild the IDENTICAL
     simulator to replay a smoke-run bundle (the replay contract: same
@@ -65,7 +66,7 @@ def build_smoke_sim(nodes: int = 16, probes: bool = True,
     return GossipSimulator(
         handler, Topology.random_regular(nodes, 4, seed=42),
         disp.stacked(), delta=20, protocol=AntiEntropyProtocol.PUSH,
-        probes=probes, sentinels=sentinels)
+        probes=probes, sentinels=sentinels, perf=perf)
 
 
 def main() -> None:
@@ -129,6 +130,24 @@ def main() -> None:
     manifest = json.load(open(manifest_path))
     assert manifest["config"]["probes"] is not None
     assert manifest["config"]["sentinels"] is not None
+    # Performance-observability block: present and null-safe on CPU —
+    # real FLOP/byte/compile numbers, MFU null (no CPU peak entry), and
+    # the per-round perf rows in the report/JSONL (ISSUE-10 acceptance).
+    perf = manifest["perf"]
+    assert perf is not None and perf["config"]["timing"]
+    assert perf["flops_per_round_xla"] and perf["flops_per_round_xla"] > 0
+    assert perf["bytes_per_round_xla"] and perf["bytes_per_round_xla"] > 0
+    assert perf["compile_count"] >= 1
+    assert perf["hbm_peak_bytes"] and perf["hbm_peak_bytes"] > 0
+    assert perf["last_run"] is not None \
+        and perf["last_run"]["ms_per_round"] > 0
+    assert perf["analytic"] is not None \
+        and perf["analytic"]["flops_per_round"] > 0
+    assert np.isfinite(report.perf_round_ms).all() \
+        and (report.perf_round_ms > 0).all()
+    assert np.array_equal(loaded.perf_round_ms, report.perf_round_ms)
+    assert all(r["perf"] is not None and r["perf"]["round_ms"] > 0
+               for r in rows)
     print(f"[ci-smoke] wrote {report_path}, {manifest_path}, {jsonl_path} "
           f"({args.rounds} rounds, {args.nodes} nodes, "
           f"{int(accepted.sum())} accepted merges, 0 sentinel trips)")

@@ -140,39 +140,41 @@ def main() -> None:
     rounds = args.rounds or (20 if args.cnn else 200)
     sampling = 0.1 if args.cnn else 0.0
 
-    # XLA's own counts on the AOT-compiled 1-round program.
+    # XLA's own counts on the AOT-compiled 1-round program, captured as
+    # the same telemetry.cost.CostReport the perf= layer banks.
+    from gossipy_tpu.telemetry import cost_report_for
     sim = build_sim(args.cnn, n_nodes, sampling_eval=sampling)
     key = jax.random.PRNGKey(42)
     state = sim.init_nodes(key)
-    compiled = sim.lower_start(state, n_rounds=1, key=key).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0]
+    cr = cost_report_for(sim, state, key, n_rounds=1, label="profile/1r")
     # Phase-scope cross-check: the named scopes the round program carries
     # (telemetry.scopes). All four in ROUND_PHASES should appear — a
     # missing one means the differential attribution below is the only
     # signal left for that phase.
     from gossipy_tpu.telemetry import ROUND_PHASES, phases_in_text
     try:
+        compiled = sim.lower_start(state, n_rounds=1, key=key).compile()
         scopes_in_hlo = phases_in_text(compiled.as_text())
     except Exception:  # some backends cannot re-serialize the executable
         scopes_in_hlo = None
 
-    full = time_config(rounds, cnn=args.cnn, n_nodes=n_nodes,
-                       sampling_eval=sampling)
-    no_eval = time_config(rounds, cnn=args.cnn, n_nodes=n_nodes,
-                          eval_every=10 * rounds, sampling_eval=sampling)
-    two_epochs = time_config(rounds, cnn=args.cnn, n_nodes=n_nodes,
-                             local_epochs=2, eval_every=10 * rounds,
-                             sampling_eval=sampling)
-    train = two_epochs - no_eval  # one epoch's marginal cost
+    # Differential attribution (telemetry.cost): eval structurally
+    # toggled, one epoch isolated, exchange = the remainder — the
+    # host-timer fallback that needs no profiler support.
+    from gossipy_tpu.telemetry import differential_phase_attribution
+    attribution = differential_phase_attribution(
+        lambda **ov: build_sim(args.cnn, n_nodes, sampling_eval=sampling,
+                               **ov),
+        rounds=rounds, key=key)
+    full = attribution["full_ms"]
+    phases_ms = attribution["phases_ms"]
     probed = None
     if args.probes:
         probed = time_config(rounds, cnn=args.cnn, n_nodes=n_nodes,
                              sampling_eval=sampling, probes=True)
 
-    flops = float(cost.get("flops", float("nan")))
-    bytes_ac = float(cost.get("bytes accessed", float("nan")))
+    flops = cr.flops if cr is not None else None
+    bytes_ac = cr.bytes_accessed if cr is not None else None
     kind = jax.devices()[0].device_kind
     print(json.dumps({
         "config": "cnn" if args.cnn else "north-star",
@@ -182,24 +184,25 @@ def main() -> None:
         "rounds_per_call": rounds,
         "ms_per_round": {
             "full": round(full, 3),
-            "eval": round(full - no_eval, 3),
-            "train_one_epoch": round(train, 3),
-            "exchange_and_overhead": round(no_eval - train, 3),
+            "eval": round(phases_ms["eval"], 3),
+            "train_one_epoch": round(phases_ms["train"], 3),
+            "exchange_and_overhead":
+                round(phases_ms["exchange_and_overhead"], 3),
             **({"probes_marginal": round(probed - full, 3)}
                if probed is not None else {}),
         },
-        "note": "differential attribution assumes steady state; at small "
-                "--rounds the legs carry run-to-run noise and can go "
-                "slightly negative",
+        "note": attribution["note"],
         "phase_scopes_in_hlo": scopes_in_hlo,
         "phase_scopes_expected": list(ROUND_PHASES),
         "xla_per_round": {
-            "gflops": round(flops / 1e9, 3) if np.isfinite(flops) else None,
+            "gflops": (round(flops / 1e9, 3)
+                       if flops is not None else None),
             "gbytes_accessed": (round(bytes_ac / 1e9, 3)
-                                if np.isfinite(bytes_ac) else None),
+                                if bytes_ac is not None else None),
         },
+        "hbm_peak_bytes": cr.peak_bytes if cr is not None else None,
         "achieved_gflops_per_s": (round(flops / (full / 1e3) / 1e9, 1)
-                                  if np.isfinite(flops) else None),
+                                  if flops is not None else None),
     }))
 
     if args.trace:
@@ -208,14 +211,44 @@ def main() -> None:
         s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile first
                           donate_state=False)
         jax.block_until_ready(s2.model.params)
-        with jax.profiler.trace(args.trace):
+        # Ask for the perfetto JSON alongside the xplane protobufs: the
+        # per-phase reducer below parses it (older jax without the kwarg
+        # still dumps the xplane trace for TensorBoard).
+        try:
+            tracer = jax.profiler.trace(args.trace,
+                                        create_perfetto_trace=True)
+        except TypeError:
+            tracer = jax.profiler.trace(args.trace)
+        with tracer:
             s3, _ = sim.start(state, n_rounds=rounds, key=key)
             jax.block_until_ready(s3.model.params)
         print(f"[profile] trace written to {args.trace}", file=sys.stderr)
-        # Cross-check the differential attribution against the scoped
-        # trace: the XProf dump should name the same phases the HLO does
-        # (open it in TensorBoard for per-op timings under each band).
-        from gossipy_tpu.telemetry import phases_in_trace_dir
+        # Direct per-phase attribution from the scoped trace — the
+        # primary signal when profiling is on (the differential numbers
+        # above are the cross-check / fallback).
+        from gossipy_tpu.telemetry import phase_times_from_trace, \
+            phases_in_trace_dir
+        from gossipy_tpu.telemetry.cost import hlo_op_phases
+        # The CPU runtime's JSON traces carry bare HLO op names without
+        # scope metadata — bridge them through the compiled program's own
+        # op_name metadata (TPU XProf dumps match on the scope directly).
+        try:
+            op_map = hlo_op_phases(
+                sim.lower_start(s3, n_rounds=rounds, key=key)
+                .compile().as_text())
+        except Exception:
+            op_map = None
+        per_phase = phase_times_from_trace(args.trace, op_to_phase=op_map)
+        if per_phase is not None:
+            total = rounds  # trace covers `rounds` rounds
+            print("[profile] trace per-phase ms/round: "
+                  + json.dumps({p: round(v / total, 3)
+                                for p, v in per_phase.items()}),
+                  file=sys.stderr)
+        else:
+            print("[profile] trace carries no parsable phase durations "
+                  "(presence check below; differential attribution is "
+                  "the timing source)", file=sys.stderr)
         in_trace = phases_in_trace_dir(args.trace)
         missing = [p for p in ROUND_PHASES if p not in in_trace]
         print(f"[profile] phase scopes in trace: {in_trace}"

@@ -1,0 +1,368 @@
+"""Self-forensic scale ladder: predicted-vs-measured cost per N rung.
+
+Walks an N ladder (default 1k → 2k → 5k → 10k → 20k → 50k → 100k) of the
+``bench.py --scale`` configuration (SparseTopology CSR, LogReg SGD, PUSH,
+capped evaluation) and records, per rung:
+
+- **predicted** memory (:meth:`GossipSimulator.memory_budget`, the
+  construction-time paper budget) and per-round FLOPs
+  (:func:`telemetry.cost.analytic_round_cost`, the model-side estimate),
+  plus a linear-in-N time prediction from the first measured rung;
+- **measured** ms/round, rounds/s and MFU estimate (the engine's
+  ``perf=`` timing), and the compiled program's OWN account of itself —
+  ``cost_analysis()`` FLOPs and ``memory_analysis()`` peak bytes, banked
+  at compile time by the perf layer.
+
+Every rung runs under the :class:`~gossipy_tpu.telemetry.FlightRecorder`
+with sentinels on, so the ~50k on-TPU crash the ROADMAP still carries
+produces, instead of a lost traceback: an exception repro bundle, and a
+ladder verdict naming the failing rung, the failing program and its
+``memory_analysis()`` numbers, and the last healthy rung. The banked
+evidence means the crash is attributable even when the process dies
+without a traceback — the crash-forensics gap ``bench.py --scale``'s
+phase stamps only narrated.
+
+Artifacts (``--out DIR``):
+
+- ``ladder.json`` — ``{"rungs": [...], "verdict": {...} | null}``
+- ``ladder.md`` — BASELINE.md-ready markdown rows
+- ``rung_<N>/bundle_*/`` — the flight-recorder bundle of a failed rung
+
+Usage (repo root):
+    python scripts/scale_ladder.py                  # the full ladder
+    python scripts/scale_ladder.py --smoke          # 4 tiny CPU rungs
+    python scripts/scale_ladder.py --rungs 1000,5000,20000 --rounds 50
+    python scripts/scale_ladder.py --smoke --fail-at 24   # forensics demo:
+        # rung 24 raises at execution time (after its program compiled,
+        # the realistic OOM shape) and the verdict names it
+
+Exit codes: 0 clean ladder, 1 a rung failed (verdict written), 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+DEFAULT_RUNGS = (1_000, 2_000, 5_000, 10_000, 20_000, 50_000, 100_000)
+SMOKE_RUNGS = (16, 24, 32, 48)
+
+
+def build_rung_sim(n_nodes: int, degree: int, rounds: int,
+                   history_dtype: str = "float32"):
+    """One rung's simulator: the ``bench.py --scale`` configuration with
+    sentinels (FlightRecorder contract) and perf (cost/timing banking)
+    on. Synthetic spambase-shaped data, 4 samples/node, eval capped the
+    same way ``bench._scale_harness`` caps it — the metric is engine
+    cost, not the learning curve."""
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, \
+        SparseTopology
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    d = 57
+    rng = np.random.default_rng(42)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(4 * n_nodes, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    eval_cap = min(2048, max(1, int(0.2 * len(X))))
+    disp = DataDispatcher(
+        ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
+        n=n_nodes, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(d, 2),
+                         loss=losses.cross_entropy,
+                         optimizer=optax.sgd(0.1),
+                         local_epochs=1, batch_size=4, n_classes=2,
+                         input_shape=(d,),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    topo = SparseTopology.random_regular(n_nodes, min(degree, n_nodes - 1),
+                                         seed=42)
+    return GossipSimulator(handler, topo, disp.stacked(), delta=100,
+                           protocol=AntiEntropyProtocol.PUSH,
+                           sampling_eval=0.01, eval_every=rounds,
+                           history_dtype=history_dtype,
+                           sentinels=True, perf=True)
+
+
+def _stamp(msg: str) -> None:
+    # The bench.py --scale discipline: phase-stamped progress so a dead
+    # run's last words name where it died even without a traceback.
+    print(f"[ladder] {time.strftime('%H:%M:%S')} {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _inject_fault(sim, n_nodes: int) -> None:
+    """--fail-at: make this rung's run raise AT EXECUTION TIME — after
+    its round program compiled and banked its CostReport — the realistic
+    OOM shape (XLA allocates the big buffers when the program runs, not
+    when it compiles). The hook rides the perf layer's post-run timing
+    call, so the recorder sees an exception out of ``sim.start`` exactly
+    like a real RESOURCE_EXHAUSTED."""
+    def boom(*a, **k):
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected ladder fault at rung "
+            f"{n_nodes} (--fail-at)")
+    sim._attach_perf_stats = boom
+
+
+def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
+             history_dtype: str, fail: bool,
+             prev: dict | None) -> dict:
+    """Run one rung; returns its ladder row. Raises on rung failure with
+    ``row_so_far`` / ``bundle`` attached to the exception (the driver
+    turns that into the verdict)."""
+    import jax
+
+    from gossipy_tpu.telemetry import FlightRecorder
+
+    row: dict = {"n_nodes": n_nodes, "degree": degree, "rounds": rounds,
+                 "history_dtype": history_dtype}
+    _stamp(f"rung {n_nodes}: building topology+simulator")
+    t0 = time.perf_counter()
+    sim = build_rung_sim(n_nodes, degree, rounds, history_dtype)
+    row["build_seconds"] = round(time.perf_counter() - t0, 2)
+
+    budget = sim.memory_budget()
+    analytic = None
+    try:
+        from gossipy_tpu.telemetry import analytic_round_cost
+        analytic = analytic_round_cost(sim)
+    except Exception:
+        pass
+    row["predicted"] = {
+        "total_bytes": budget.get("total_bytes"),
+        "history_ring_bytes": budget.get("history_ring_bytes"),
+        "eval_peak_bytes": budget.get("eval_peak_bytes"),
+        "flops_per_round": (analytic or {}).get("flops_per_round"),
+        "flops_per_round_executed":
+            (analytic or {}).get("flops_per_round_executed"),
+        # Linear-in-N extrapolation from the previous measured rung: the
+        # sparse round program's dominant terms all scale with N, so a
+        # super-linear measured/predicted ratio is itself a finding.
+        "ms_per_round": (
+            None if prev is None or not prev.get("measured")
+            else prev["measured"]["ms_per_round"]
+            * n_nodes / prev["n_nodes"]),
+    }
+    _stamp(f"rung {n_nodes}: predicted "
+           f"{(budget.get('total_bytes') or 0) / 2**20:.1f} MB, "
+           f"analytic {(row['predicted']['flops_per_round'] or 0) / 1e6:.1f}"
+           " MFLOP/round")
+
+    rung_dir = os.path.join(out_dir, f"rung_{n_nodes}")
+    os.makedirs(rung_dir, exist_ok=True)
+    rec = FlightRecorder(rung_dir, chunk=rounds)
+    key = jax.random.PRNGKey(42)
+    _stamp(f"rung {n_nodes}: init_nodes")
+    state = sim.init_nodes(key)
+    if fail:
+        _inject_fault(sim, n_nodes)
+    _stamp(f"rung {n_nodes}: compile + {rounds}-round run "
+           "(flight recorder armed)")
+    try:
+        state, reports, bundle = rec.run(sim, state, n_rounds=rounds,
+                                         key=key)
+    except Exception as e:
+        e.ladder_row = row  # type: ignore[attr-defined]
+        e.ladder_bundle = rec.bundle_path  # type: ignore[attr-defined]
+        e.ladder_sim = sim  # type: ignore[attr-defined]
+        raise
+    if bundle is not None:
+        e = RuntimeError(f"rung {n_nodes}: sentinel tripped "
+                         f"(bundle at {bundle})")
+        e.ladder_row = row  # type: ignore[attr-defined]
+        e.ladder_bundle = bundle  # type: ignore[attr-defined]
+        e.ladder_sim = sim  # type: ignore[attr-defined]
+        raise e
+
+    last = sim._perf_last or {}
+    cr = sim._cost_reports[-1].to_dict() if sim._cost_reports else {}
+    ms = last.get("ms_per_round")
+    row["measured"] = {
+        "ms_per_round": ms,
+        "rounds_per_sec": (round(1e3 / ms, 3) if ms else None),
+        "mfu_est": last.get("mfu_est"),
+        "flops_per_round_xla": cr.get("flops"),
+        "bytes_per_round_xla": cr.get("bytes_accessed"),
+        "hbm_peak_bytes": cr.get("peak_bytes"),
+        "temp_bytes": cr.get("temp_bytes"),
+        "compile_seconds": sim.last_compile_seconds,
+        "program": cr.get("label"),
+    }
+    pred_ms = row["predicted"]["ms_per_round"]
+    if pred_ms and ms:
+        row["time_predicted_over_measured"] = round(pred_ms / ms, 3)
+    pred_b = row["predicted"]["total_bytes"]
+    meas_b = row["measured"]["hbm_peak_bytes"]
+    if pred_b and meas_b:
+        row["memory_predicted_over_measured"] = round(pred_b / meas_b, 3)
+    _stamp(f"rung {n_nodes}: {ms and round(ms, 2)} ms/round, "
+           f"hbm peak {(meas_b or 0) / 2**20:.1f} MB")
+    return row
+
+
+def _verdict_for(exc: Exception, n_nodes: int,
+                 last_healthy: int | None) -> dict:
+    """The ladder verdict: name the failing rung, the failing program
+    and its memory_analysis numbers (banked at compile time — available
+    even when the failure lost its traceback), and the last healthy
+    rung. Falls back to the construction-time memory budget when the
+    rung died before its program compiled."""
+    sim = getattr(exc, "ladder_sim", None)
+    row = getattr(exc, "ladder_row", None) or {}
+    program = None
+    memory = None
+    if sim is not None and getattr(sim, "_cost_reports", None):
+        cr = sim._cost_reports[-1]
+        program = cr.label
+        memory = {k: v for k, v in cr.to_dict().items()
+                  if k.endswith("_bytes") or k == "peak_bytes"}
+    if memory is None:
+        program = "uncompiled (failed before/at compile)"
+        memory = {"memory_budget_fallback": row.get("predicted")}
+    return {
+        "failed_rung": n_nodes,
+        "last_healthy_rung": last_healthy,
+        "program": program,
+        "memory_analysis": memory,
+        "predicted": row.get("predicted"),
+        "error": repr(exc)[:500],
+        "bundle": getattr(exc, "ladder_bundle", None),
+    }
+
+
+def _markdown(rows: list, verdict: dict | None) -> str:
+    lines = [
+        "| N | predicted MB | hbm peak MB | ms/round | rounds/s | "
+        "MFU est | pred/meas time |",
+        "|---|---|---|---|---|---|---|",
+    ]
+
+    def mb(v):
+        return f"{v / 2**20:.1f}" if v else "—"
+    for r in rows:
+        m = r.get("measured") or {}
+        mfu = m.get("mfu_est")
+        lines.append(
+            f"| {r['n_nodes']:,} "
+            f"| {mb((r.get('predicted') or {}).get('total_bytes'))} "
+            f"| {mb(m.get('hbm_peak_bytes'))} "
+            f"| {m.get('ms_per_round') and round(m['ms_per_round'], 2)} "
+            f"| {m.get('rounds_per_sec') or '—'} "
+            f"| {f'{mfu:.4f}' if mfu is not None else 'null'} "
+            f"| {r.get('time_predicted_over_measured') or '—'} |")
+    if verdict is not None:
+        lines.append("")
+        lines.append(f"**FAILED** at rung {verdict['failed_rung']:,} "
+                     f"(last healthy: {verdict['last_healthy_rung']}): "
+                     f"program `{verdict['program']}`, "
+                     f"`{verdict['error']}`")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rungs", default=None,
+                    help="comma-separated node counts "
+                         "(default: 1k,2k,5k,10k,20k,50k,100k)")
+    ap.add_argument("--smoke", action="store_true",
+                    help=f"tiny CPU rungs {SMOKE_RUNGS} (CI smoke)")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="rounds per rung (default 100; 3 with --smoke)")
+    ap.add_argument("--degree", type=int, default=None,
+                    help="regular-graph degree (default 20; 4 with "
+                         "--smoke, whose rungs are too small for 20)")
+    ap.add_argument("--out", default="ladder-artifacts")
+    ap.add_argument("--history-dtype", default="float32",
+                    choices=("float32", "bfloat16", "int8"))
+    ap.add_argument("--fail-at", type=int, default=None, metavar="N",
+                    help="inject an execution-time fault at rung N "
+                         "(forensics self-test: the verdict must name it)")
+    args = ap.parse_args(argv)
+
+    if args.rungs:
+        try:
+            rungs = tuple(int(x) for x in args.rungs.split(","))
+        except ValueError:
+            print(f"[ladder] unparsable --rungs {args.rungs!r}",
+                  file=sys.stderr)
+            return 2
+        if any(r < 2 for r in rungs):
+            print("[ladder] rungs must be >= 2", file=sys.stderr)
+            return 2
+    else:
+        rungs = SMOKE_RUNGS if args.smoke else DEFAULT_RUNGS
+    rounds = args.rounds or (3 if args.smoke else 100)
+    degree = args.degree or (4 if args.smoke else 20)
+    os.makedirs(args.out, exist_ok=True)
+
+    # A wedged accelerator tunnel must degrade to CPU, not hang the
+    # ladder (the bench.py / profile_round.py discipline).
+    import _virtual_mesh
+    ok, detail = _virtual_mesh.probe_backend_alive()
+    if not ok:
+        print(f"[ladder] backend unreachable ({detail}); re-exec on CPU",
+              file=sys.stderr)
+        env = _virtual_mesh.virtual_mesh_env(1, extra_path=_REPO)
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    import jax
+
+    from gossipy_tpu import enable_compilation_cache
+    enable_compilation_cache()
+    _stamp(f"backend {jax.default_backend()} "
+           f"({jax.devices()[0].device_kind}); rungs {rungs}, "
+           f"{rounds} rounds/rung")
+
+    rows: list = []
+    verdict = None
+    last_healthy = None
+    for n in rungs:
+        try:
+            row = run_rung(n, degree, rounds, args.out,
+                           args.history_dtype, fail=(args.fail_at == n),
+                           prev=rows[-1] if rows else None)
+        except Exception as e:
+            verdict = _verdict_for(e, n, last_healthy)
+            rows.append(getattr(e, "ladder_row", None)
+                        or {"n_nodes": n, "failed": True})
+            _stamp(f"rung {n} FAILED: {verdict['error']} "
+                   f"(program {verdict['program']}; "
+                   f"bundle {verdict['bundle']})")
+            break
+        rows.append(row)
+        last_healthy = n
+
+    out = {"schema": 1,
+           "backend": jax.default_backend(),
+           "device_kind": jax.devices()[0].device_kind,
+           "rounds_per_rung": rounds,
+           "rungs": rows,
+           "verdict": verdict}
+    path = os.path.join(args.out, "ladder.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+        fh.write("\n")
+    md_path = os.path.join(args.out, "ladder.md")
+    with open(md_path, "w") as fh:
+        fh.write(_markdown([r for r in rows if "predicted" in r], verdict))
+    _stamp(f"wrote {path} and {md_path} "
+           f"({len(rows)} rungs{'; VERDICT' if verdict else ''})")
+    return 1 if verdict else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
