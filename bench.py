@@ -1363,6 +1363,40 @@ def _mode_arg(flag: str, default: int, minimum: int) -> int:
     return val
 
 
+def bench_service_slo(n_tenants: int) -> None:
+    """Sustained mixed-shape arrival benchmark (``--service-slo [T]``):
+    T tenants arrive as a compressed Poisson process over the built-in
+    two-shape spec pool and are served open-loop by the incremental
+    multi-tenant scheduler (gossipy_tpu.service.slo). The row is the
+    ROADMAP always-on-service item's "Done" evidence: realized
+    tenants/hour plus p50/p99 time-to-first-round and p99 per-round
+    latency, with every admitted tenant's TTFR accounted for. Emitted
+    through :func:`emit` so the backend/degraded stamps ride along."""
+    import shutil
+    import tempfile
+
+    from gossipy_tpu.service.slo import run_load
+    from gossipy_tpu.telemetry.metrics import MetricsRegistry, set_registry
+
+    out = tempfile.mkdtemp(prefix="bench-slo-")
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        result = run_load(out, n_tenants=n_tenants, rate_per_hour=1200.0,
+                          seed=0, slice_rounds=3, registry=reg,
+                          time_scale=0.001)
+    finally:
+        set_registry(prev)
+        shutil.rmtree(out, ignore_errors=True)
+    row = result["row"]
+    raw = row["raw"]
+    print(f"[bench] service-slo: {raw['n_admitted']} tenants in "
+          f"{raw['wall_seconds']}s -> {row['value']} tenants/hour, "
+          f"ttfr p99 {raw['ttfr_p99_ms']} ms, "
+          f"round p99 {raw['round_p99_ms']} ms", file=sys.stderr)
+    emit(row)
+
+
 _USAGE = """usage: python bench.py [MODE]
 
 Driver contract: prints ONE JSON line; degrades to a labeled CPU fallback
@@ -1383,6 +1417,12 @@ modes (default: the 100-node north-star, ours vs the live reference):
   --fused-regime [ROUNDS]   pallas fused merge vs XLA gather+blend
   --ring-attn [S]           flash-attention kernel vs XLA dense attention
   --to-acc TARGET           wall-clock to reach TARGET global accuracy
+  --service-slo [T]         sustained mixed-shape arrival benchmark: T
+                            Poisson-arriving tenants served open-loop by
+                            the multi-tenant scheduler; the row carries
+                            tenants/hour, p50/p99 time-to-first-round and
+                            p99 round latency (scripts/loadgen.py is the
+                            standalone driver)
   --print-deadline [MODE]   print the mode's watchdog deadline and exit
 
 options (compose with any mode):
@@ -1449,6 +1489,9 @@ def main():
     elif "--ring-attn" in sys.argv:
         mode, mode_arg = "ring-attn", _mode_arg("--ring-attn", default=8192,
                                                 minimum=16)
+    elif "--service-slo" in sys.argv:
+        mode, mode_arg = "service-slo", _mode_arg("--service-slo",
+                                                  default=6, minimum=1)
     elif "--to-acc" in sys.argv:
         try:
             mode_arg = float(sys.argv[sys.argv.index("--to-acc") + 1])
@@ -1506,6 +1549,9 @@ def main():
         return
     if mode == "ring-attn":
         bench_ring_attention(mode_arg)
+        return
+    if mode == "service-slo":
+        bench_service_slo(mode_arg)
         return
     X, y = make_data()
     if mode == "to-acc":

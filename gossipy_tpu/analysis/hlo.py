@@ -204,6 +204,10 @@ def gate_cases() -> dict:
         # stronger than the other layers' off-identity contract.
         ("engine/perf-on",
          lambda: _make_sim(), lambda: _make_sim(perf=True)),
+        # metrics (telemetry.metrics) is host-side only, like perf: the
+        # SLO registry feed must be HLO-invisible even when ON.
+        ("engine/metrics-on",
+         lambda: _make_sim(), lambda: _make_sim(metrics=True)),
         ("all2all/sentinels-off",
          lambda: _make_sim(all2all=True),
          lambda: _make_sim(all2all=True, sentinels=None)),

@@ -51,6 +51,11 @@ registry-field      a ``probe_*``/``health_*``/``chaos_*``/``perf_*``
                     silently vanish from save/load/concatenate
 schema-tolerance    ``JSONLinesReceiver.SCHEMA`` was bumped past the
                     versions ``parse_line`` tolerates
+metrics-in-trace    a call that resolves into ``telemetry.metrics``
+                    (registry/counter/histogram APIs) reachable from a
+                    traced root — metrics are host-side sinks, same
+                    contract as io_callback bodies; record after the
+                    run, or from inside a host callback
 =================== =====================================================
 
 Suppression: append ``# tracelint: disable=<rule>[,<rule>...]`` (or
@@ -78,7 +83,15 @@ ALL_RULES = {
     "use-after-donate": "donated buffer read after the donating call",
     "registry-field": "per-round stat key missing from the report registry",
     "schema-tolerance": "JSONL SCHEMA bumped past parse_line's tolerance",
+    "metrics-in-trace": "telemetry.metrics registry call in a traced region",
 }
+
+# The SLO metrics registry (telemetry.metrics) is a HOST sink by
+# contract — the same boundary io_callback bodies live under. Any call
+# that resolves into this module from a traced region is a finding: at
+# best it concretizes a tracer into a counter, at worst it silently
+# records trace-time constants once per compile instead of run values.
+_METRICS_MODULE = "gossipy_tpu/telemetry/metrics.py"
 
 # Call-name suffix -> positions of function-valued operands that are traced.
 # None means "every positional argument from index 0" (switch: from 1).
@@ -1152,19 +1165,38 @@ def run_tracelint(root, sources: Optional[dict] = None,
         lambda_regions.extend(
             (modules[rel], lam) for lam, _ in finder.lambda_roots)
 
+    findings: list[Finding] = []
+
+    def _metrics_finding(mod: _Module, node: ast.Call):
+        line = getattr(node, "lineno", 1)
+        text = mod.lines[line - 1].strip() \
+            if 0 < line <= len(mod.lines) else ""
+        findings.append(Finding(
+            rule="metrics-in-trace", path=mod.relpath, line=line,
+            col=getattr(node, "col_offset", 0),
+            message="telemetry.metrics registry call reachable from a "
+                    "traced root — metrics are host-side sinks (same "
+                    "contract as io_callback bodies); record after the "
+                    "run or from inside a host callback",
+            snippet=text))
+
     # Propagate tracedness through repo-internal calls. Only a function's
     # OWN code propagates — nested defs are separate regions reached via
     # resolve_call (so an io_callback body inside a traced method never
-    # drags its host-side helpers into the traced set).
+    # drags its host-side helpers into the traced set). A call resolving
+    # into telemetry.metrics does NOT propagate — it is reported as a
+    # metrics-in-trace finding instead (the registry is a host sink by
+    # contract; tracing into it would also mis-lint its own host code).
     while worklist:
         fn = worklist.pop()
         mod = modules[fn.module]
         for node in _own_nodes(fn.node):
             if isinstance(node, ast.Call):
                 for callee in repo.resolve_call(mod, node, fn):
-                    add(callee)
-
-    findings: list[Finding] = []
+                    if callee.module == _METRICS_MODULE:
+                        _metrics_finding(mod, node)
+                    else:
+                        add(callee)
     for fn in traced.values():
         mod = modules[fn.module]
         statics, nums = static_info.get(id(fn.node),

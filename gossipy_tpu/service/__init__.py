@@ -29,12 +29,21 @@ from .packer import (
     pack,
     shape_signature,
 )
-from .scheduler import GossipService
+from .scheduler import GossipService, ServiceSession
+from .slo import (
+    default_spec_pool,
+    make_requests,
+    poisson_arrivals,
+    run_load,
+    slo_row,
+)
 from .spec import RunHandle, RunQueue, RunRequest, RunStatus
 
 __all__ = [
     "RunRequest", "RunHandle", "RunQueue", "RunStatus",
     "ShapeSignature", "BuiltRun", "Bucket", "shape_signature",
     "build_request", "pack",
-    "GossipService",
+    "GossipService", "ServiceSession",
+    "default_spec_pool", "make_requests", "poisson_arrivals",
+    "run_load", "slo_row",
 ]

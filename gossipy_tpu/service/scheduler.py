@@ -23,6 +23,17 @@ telemetry routing and failure handling live in a host-side control plane
   plus per-tenant cost attribution — tenant-seconds of measured slice
   wall time and estimated FLOPs from the step program's own
   ``cost_analysis()`` — under ``extra.service.perf``);
+- **meters** everything into the process SLO metrics registry
+  (:mod:`gossipy_tpu.telemetry.metrics`; catalogue in docs/service.md):
+  queue-wait and per-bucket compile seconds at admission,
+  time-to-first-round per tenant, slice/round latency histograms,
+  evictions by cause, and per-tenant tenant-seconds (the fair-share
+  currency) — all HOST-side, never from a traced region (tracelint's
+  ``metrics-in-trace`` rule enforces the boundary), with the per-tenant
+  SLO record also stamped in-band (``extra.service.slo``); an
+  incremental :class:`ServiceSession` (admit/poll/finish) lets tenants
+  ARRIVE while buckets are mid-flight — the sustained-arrival SLO
+  harness (:mod:`gossipy_tpu.service.slo`) drives it open-loop;
 - **survives tenant failure**: each slice's start states are kept as
   host-side last-healthy copies; when a tenant's in-graph ``health_trip``
   sentinel fires, the scheduler writes that tenant's flight-recorder
@@ -56,8 +67,54 @@ from ..simulation.engine import BATCH_AXIS
 from ..simulation.events import JSONLinesReceiver, SimulationEventSender
 from ..telemetry import RunManifest, emit_event
 from ..telemetry.health import FlightRecorder
+from ..telemetry.metrics import MetricsRegistry, get_registry
 from .packer import Bucket, BuiltRun, build_request, pack
 from .spec import RunQueue, RunRequest, RunStatus
+
+
+def _service_metrics(reg: MetricsRegistry) -> dict:
+    """Get-or-create the scheduler's metric families on ``reg`` (the SLO
+    metric catalogue — docs/service.md documents each). Idempotent: the
+    registry's family accessors are get-or-create by name."""
+    return {
+        "admitted": reg.counter(
+            "service_tenants_admitted_total",
+            "tenants packed into a bucket", ("bucket",)),
+        "finished": reg.counter(
+            "service_tenants_finished_total",
+            "tenants that left the service, by final status",
+            ("status",)),
+        "evictions": reg.counter(
+            "service_evictions_total",
+            "tenants evicted/failed mid-run, by cause", ("cause",)),
+        "queue_wait": reg.histogram(
+            "service_queue_wait_seconds",
+            "submission -> bucket admission wait", ("bucket",)),
+        "ttfr": reg.histogram(
+            "service_ttfr_seconds",
+            "submission -> first completed round (time-to-first-round)"),
+        "ttfr_tenant": reg.gauge(
+            "service_tenant_ttfr_seconds",
+            "per-tenant time-to-first-round", ("tenant",)),
+        "compile": reg.gauge(
+            "service_compile_seconds",
+            "bucket program build+compile wall seconds",
+            ("bucket", "program")),
+        "slice": reg.histogram(
+            "service_slice_seconds",
+            "one cooperative slice's wall seconds", ("bucket",)),
+        "round": reg.histogram(
+            "service_round_seconds",
+            "per-round latency (slice wall / rounds in slice)",
+            ("bucket",)),
+        "rounds": reg.counter(
+            "service_rounds_total",
+            "tenant-rounds harvested", ("bucket",)),
+        "tenant_seconds": reg.counter(
+            "service_tenant_seconds_total",
+            "per-tenant share of measured bucket wall time "
+            "(the fair-share currency)", ("tenant",)),
+    }
 
 
 class _TenantSender(SimulationEventSender):
@@ -71,8 +128,13 @@ class _BucketRuntime:
     compiled programs, and the per-slice harvest loop."""
 
     def __init__(self, bucket: Bucket, out_root: str, slice_rounds: int,
-                 keep_repro: bool, events_jsonl: bool):
+                 keep_repro: bool, events_jsonl: bool,
+                 registry: Optional[MetricsRegistry] = None):
         self.bucket = bucket
+        self._reg = registry if registry is not None else get_registry()
+        self._m = _service_metrics(self._reg)
+        self._digest8 = bucket.signature.digest[:8]
+        self._queue_wait: dict[int, float] = {}
         self.sim = bucket.runs[0].sim  # the representative: ONLY sim run
         self.slice_rounds = int(slice_rounds)
         self.keep_repro = keep_repro
@@ -211,9 +273,22 @@ class _BucketRuntime:
                        donate_argnums=(0,))
 
     def initialize(self) -> None:
+        t_adm = time.time()
+        for i, r in enumerate(self.bucket.runs):
+            # Queue wait: submission -> this bucket starting to compile.
+            wait = max(t_adm - r.handle.submitted_at, 0.0)
+            self._queue_wait[i] = wait
+            self._m["queue_wait"].labels(bucket=self._digest8).observe(wait)
+        self._m["admitted"].labels(bucket=self._digest8).inc(
+            self.bucket.size)
+        t0 = time.perf_counter()
         self._init_fn = self._make_init()
         self._step_fn = self._make_step()
         self.states = self._init_fn(self.keys, self.data)
+        jax.block_until_ready(jax.tree.leaves(self.states)[0])
+        self._m["compile"].labels(bucket=self._digest8,
+                                  program="init").set_value(
+            time.perf_counter() - t0)
         if self.sentinels_on:
             zero = self.sim._health_zero_carry()
             self.hc = jax.tree.map(
@@ -255,7 +330,11 @@ class _BucketRuntime:
                 step_args = (self.states, self.keys, self.data, self.drop,
                              self.online, self.hc, self.chaos_scheds)
                 if self._step_compiled is None:
+                    t_c0 = time.perf_counter()
                     self._step_compiled = self._compile_step(step_args)
+                    self._m["compile"].labels(
+                        bucket=self._digest8, program="step").set_value(
+                        time.perf_counter() - t_c0)
                 self.states, self.hc, stats = self._step_compiled(
                     *step_args)
                 host = jax.tree.map(np.asarray, stats)
@@ -267,6 +346,9 @@ class _BucketRuntime:
         # The host transfer above forces completion, so this wall time is
         # the slice's real cost, attributed evenly across live lanes.
         slice_wall = time.perf_counter() - t_slice0
+        self._m["slice"].labels(bucket=self._digest8).observe(slice_wall)
+        self._m["round"].labels(bucket=self._digest8).observe(
+            slice_wall / max(self.slice_rounds, 1))
         per_lane_round_flops = (
             self._step_cost.flops / max(self.bucket.size, 1)
             if self._step_cost is not None and self._step_cost.flops
@@ -286,6 +368,16 @@ class _BucketRuntime:
                 nz = np.nonzero(np.asarray(rows["health_trip"]) > 0)[0]
                 trip_idx = int(nz[0]) if nz.size else None
             self._tenant_seconds[i] += slice_wall / len(lanes)
+            self._m["tenant_seconds"].labels(tenant=run.tenant).inc(
+                slice_wall / len(lanes))
+            if h.rounds_completed == 0 and take > 0:
+                # Time-to-first-round: the tenant's first completed round
+                # became observable when this slice's results landed.
+                h.first_round_at = time.time()
+                ttfr = max(h.first_round_at - h.submitted_at, 0.0)
+                self._m["ttfr"].observe(ttfr)
+                self._m["ttfr_tenant"].labels(
+                    tenant=run.tenant).set_value(ttfr)
             if per_lane_round_flops is not None:
                 rounds_taken = take if trip_idx is None else trip_idx + 1
                 self._tenant_flops[i] += \
@@ -294,10 +386,13 @@ class _BucketRuntime:
                 rows = {k: v[:trip_idx + 1] for k, v in rows.items()}
                 self._harvest_rows(i, rows, chunk_start)
                 h.rounds_completed += trip_idx + 1
+                self._m["rounds"].labels(bucket=self._digest8).inc(
+                    trip_idx + 1)
                 self._evict(i, chunk_start + trip_idx, rows)
             else:
                 self._harvest_rows(i, rows, chunk_start)
                 h.rounds_completed += take
+                self._m["rounds"].labels(bucket=self._digest8).inc(take)
                 if h.rounds_completed >= self.requested[i]:
                     self._finalize(i, RunStatus.DONE)
         if not self._live_lanes():
@@ -408,16 +503,38 @@ class _BucketRuntime:
                                      if self._step_cost is not None
                                      else None),
                 },
+                # In-band SLO record for THIS tenant (telemetry.metrics):
+                # the future fair-share scheduler's currency travels with
+                # the tenant, not only in the process registry. Bucket
+                # round-latency percentiles come from the registry's own
+                # log-bucket estimator.
+                "slo": self._tenant_slo(i),
             }},
             config_overrides={"drop_prob": cfg.drop_prob,
                               "online_prob": cfg.online_prob,
                               "seed": cfg.seed,
                               "tenant": run.tenant})
 
+    def _tenant_slo(self, i: int) -> dict:
+        run = self.bucket.runs[i]
+        h = run.handle
+        rh = self._m["round"].labels(bucket=self._digest8)
+        ttfr = (h.first_round_at - h.submitted_at
+                if h.first_round_at is not None else None)
+        return {
+            "queue_wait_seconds": round(self._queue_wait.get(i, 0.0), 6),
+            "ttfr_seconds": round(ttfr, 6) if ttfr is not None else None,
+            "tenant_seconds": round(self._tenant_seconds[i], 6),
+            "rounds_completed": h.rounds_completed,
+            "bucket_round_seconds_p50": rh.quantile(0.5),
+            "bucket_round_seconds_p99": rh.quantile(0.99),
+        }
+
     def _finalize(self, i: int, status: RunStatus) -> None:
         run = self.bucket.runs[i]
         h = run.handle
         h.status = status
+        self._m["finished"].labels(status=status.value).inc()
         h.report = self._build_tenant_report(i)
         out = self.out_dirs[i]
         if h.report is not None:
@@ -455,6 +572,7 @@ class _BucketRuntime:
                 self.sim, self._healthy[i], np.asarray(run.key), "sentinel",
                 self._healthy_round, first_bad_round=bad_round,
                 detail=detail, rounds_recorded=h.rounds_completed)
+        self._m["evictions"].labels(cause="sentinel").inc()
         emit_event("tenant_evicted", {
             "tenant": run.tenant,
             "bucket": self.bucket.signature.digest,
@@ -473,6 +591,7 @@ class _BucketRuntime:
             run = self.bucket.runs[i]
             h = run.handle
             h.error = repr(error)[:500]
+            self._m["evictions"].labels(cause="exception").inc()
             if self.keep_repro and i in self._healthy:
                 rec = FlightRecorder(self.out_dirs[i])
                 try:
@@ -543,7 +662,9 @@ class GossipService:
 
     def __init__(self, out_dir: str, slice_rounds: int = 25,
                  keep_repro: bool = True, sentinels_default: bool = True,
-                 events_jsonl: bool = True):
+                 events_jsonl: bool = True,
+                 metrics_dir: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         self.slice_rounds = int(slice_rounds)
@@ -551,6 +672,9 @@ class GossipService:
         self.keep_repro = bool(keep_repro)
         self.sentinels_default = bool(sentinels_default)
         self.events_jsonl = bool(events_jsonl)
+        self.metrics_dir = (os.path.abspath(metrics_dir)
+                            if metrics_dir else None)
+        self.registry = registry if registry is not None else get_registry()
 
     def run(self, requests: list[RunRequest]) -> dict:
         """Serve a fixed batch of requests (sugar over :meth:`serve`)."""
@@ -559,54 +683,135 @@ class GossipService:
             q.submit(r)
         return self.serve(q)
 
+    def session(self, queue: RunQueue) -> "ServiceSession":
+        """Open an incremental serving session over ``queue`` — the
+        arrival-driven face of the service (``scripts/loadgen.py``):
+        tenants may be submitted WHILE earlier buckets are mid-flight;
+        each :meth:`ServiceSession.poll` packs whatever is newly pending
+        into fresh buckets and advances every live bucket one slice."""
+        return ServiceSession(self, queue)
+
     def serve(self, queue: RunQueue) -> dict:
         """Drain everything pending in ``queue``: build each request,
         pack into shape buckets, drive all buckets to completion, write
         per-tenant artifacts plus a ``service_summary.json``. Returns the
-        summary dict; per-tenant state lives on the queue's handles."""
-        t0 = time.time()
+        summary dict; per-tenant state lives on the queue's handles.
+        (One-shot sugar over :meth:`session` — batch admission, then
+        poll to empty.)"""
+        session = self.session(queue)
+        while session.poll():
+            pass
+        return session.finish()
+
+
+class ServiceSession:
+    """One incremental serving run: admission, cooperative driving and
+    metrics snapshots, decoupled so arrivals can interleave with
+    progress. The scheduler's open-loop face:
+
+    - :meth:`poll` — admit whatever the queue holds as QUEUED (build,
+      pack, compile — new buckets only; running buckets are untouched),
+      then advance every live bucket by ONE cooperative slice. Returns
+      True while anything is still live. Writes a fresh registry
+      snapshot to the service's ``metrics_dir`` each cycle — the file
+      ``scripts/service_top.py`` tails.
+    - :meth:`finish` — per-tenant artifacts are already on disk (written
+      at each tenant's finalize); this writes ``service_summary.json``
+      plus the final metrics snapshot + OpenMetrics export and returns
+      the summary dict.
+
+    Queue-wait and time-to-first-round are measured against each
+    handle's ``submitted_at``, so a tenant that waited behind running
+    buckets carries its real wait, not the batch's."""
+
+    def __init__(self, service: GossipService, queue: RunQueue):
+        self.service = service
+        self.queue = queue
+        self.runtimes: list[_BucketRuntime] = []
+        self.t0 = time.time()
+        if service.metrics_dir:
+            os.makedirs(service.metrics_dir, exist_ok=True)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit_pending(self) -> int:
+        """Build + pack every QUEUED handle into new buckets and start
+        them. Returns how many tenants were admitted. A spec that fails
+        to build FAILS alone, without disturbing anything running."""
+        svc = self.service
         built: list[BuiltRun] = []
-        for h in queue.pending():
+        for h in self.queue.pending():
             try:
                 built.append(build_request(
                     h.request, handle=h,
-                    sentinels_default=self.sentinels_default))
+                    sentinels_default=svc.sentinels_default))
             except Exception as e:
                 h.status = RunStatus.FAILED
                 h.error = repr(e)[:500]
+        if not built:
+            return 0
         buckets = pack(built)
         emit_event("service_packed", {
             "tenants": [b.tenant for b in built],
             "buckets": [{"bucket": b.signature.digest,
                          "tenants": b.tenants} for b in buckets],
         })
-        runtimes = [
-            _BucketRuntime(b, self.out_dir, self.slice_rounds,
-                           self.keep_repro, self.events_jsonl)
-            for b in buckets]
-        for rt in runtimes:
+        new = [_BucketRuntime(b, svc.out_dir, svc.slice_rounds,
+                              svc.keep_repro, svc.events_jsonl,
+                              registry=svc.registry)
+               for b in buckets]
+        for rt in new:
             rt.initialize()
-        # Cooperative loop: one slice per live bucket per cycle.
-        while any(rt.live for rt in runtimes):
-            for rt in runtimes:
-                if rt.live:
-                    rt.step()
+        self.runtimes.extend(new)
+        return len(built)
+
+    # -- driving -----------------------------------------------------------
+
+    def any_live(self) -> bool:
+        return any(rt.live for rt in self.runtimes)
+
+    def poll(self) -> bool:
+        """One cooperative cycle: admit arrivals, advance each live
+        bucket one slice, refresh the metrics snapshot. Returns True
+        while any bucket is still live (callers loop on it)."""
+        self.admit_pending()
+        for rt in self.runtimes:
+            if rt.live:
+                rt.step()
+        self._write_metrics()
+        return self.any_live()
+
+    def _write_metrics(self) -> None:
+        if self.service.metrics_dir:
+            self.service.registry.save(
+                os.path.join(self.service.metrics_dir, "metrics.json"))
+
+    # -- completion --------------------------------------------------------
+
+    def finish(self) -> dict:
+        svc = self.service
         summary = {
-            "out_dir": self.out_dir,
-            "wall_seconds": round(time.time() - t0, 3),
-            "slice_rounds": self.slice_rounds,
-            "n_tenants": len(queue.handles()),
-            "n_buckets": len(buckets),
-            "megabatch_step_programs": len(buckets),
+            "out_dir": svc.out_dir,
+            "wall_seconds": round(time.time() - self.t0, 3),
+            "slice_rounds": svc.slice_rounds,
+            "n_tenants": len(self.queue.handles()),
+            "n_buckets": len(self.runtimes),
+            "megabatch_step_programs": len(self.runtimes),
             "compilation_cache": compilation_cache_stats(),
-            "buckets": [rt.summary() for rt in runtimes],
-            "tenants": [h.to_dict() for h in queue.handles()],
+            "buckets": [rt.summary() for rt in self.runtimes],
+            "tenants": [h.to_dict() for h in self.queue.handles()],
         }
-        path = os.path.join(self.out_dir, "service_summary.json")
+        path = os.path.join(svc.out_dir, "service_summary.json")
         with open(path, "w") as fh:
             json.dump(summary, fh, indent=2, default=str)
             fh.write("\n")
         summary["summary_path"] = path
+        if svc.metrics_dir:
+            self._write_metrics()
+            om = os.path.join(svc.metrics_dir, "metrics.prom")
+            with open(om, "w") as fh:
+                fh.write(svc.registry.to_openmetrics())
+            summary["metrics_dir"] = svc.metrics_dir
         emit_event("service_done", {
             "n_tenants": summary["n_tenants"],
             "n_buckets": summary["n_buckets"],

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import time
 from typing import Any, Optional
 
 from ..config import ExperimentConfig
@@ -122,6 +123,11 @@ class RunHandle:
     error: Optional[str] = None
     bucket: Optional[str] = None          # signature digest once packed
     artifacts: dict = dataclasses.field(default_factory=dict)
+    # SLO clock anchors (telemetry.metrics): stamped at submission /
+    # first completed round, the raw material for queue-wait and
+    # time-to-first-round. Wall-clock epoch seconds.
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    first_round_at: Optional[float] = None
 
     @property
     def tenant(self) -> str:
@@ -138,6 +144,10 @@ class RunHandle:
             "bundle_path": self.bundle_path,
             "error": self.error,
             "artifacts": dict(self.artifacts),
+            "submitted_at": self.submitted_at,
+            "ttfr_seconds": (
+                round(self.first_round_at - self.submitted_at, 6)
+                if self.first_round_at is not None else None),
         }
 
 

@@ -473,7 +473,8 @@ class GossipSimulator(SimulationEventSender):
                  probes: Union[None, bool, ProbeConfig] = None,
                  sentinels: Union[None, bool, SentinelConfig] = None,
                  chaos: Union[None, dict, ChaosConfig] = None,
-                 perf: Union[None, bool, PerfConfig] = None):
+                 perf: Union[None, bool, PerfConfig] = None,
+                 metrics: Union[None, bool] = None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -639,6 +640,16 @@ class GossipSimulator(SimulationEventSender):
         self.perf: Optional[PerfConfig] = PerfConfig.coerce(perf)
         self._cost_reports: list = []
         self._perf_last: Optional[dict] = None
+        # SLO metrics feed (telemetry.metrics): like perf, this layer is
+        # host-side ONLY — nothing traced reads it, metrics on and off
+        # compile byte-identical HLO (gate pair engine/metrics-on). When
+        # enabled, every finished start() segment increments the
+        # process registry's engine_rounds/sent/failed-by-cause counters
+        # (sourced from the FailureCounts arrays the report carries) and
+        # the JSONL event stream's per-round rows gain a cumulative
+        # ``metrics`` block (schema v7).
+        self.metrics_enabled: bool = bool(metrics)
+        self._metrics_base = {"rounds": 0, "sent": 0, "failed": 0}
         self.chaos: Optional[ChaosConfig] = ChaosConfig.coerce(chaos)
         self.chaos_schedule = None
         self._chaos_edge_form: Optional[str] = None
@@ -2074,6 +2085,38 @@ class GossipSimulator(SimulationEventSender):
         }
         return stats
 
+    def _feed_metrics(self, stats: dict, report, n_rounds: int) -> dict:
+        """Host-side SLO-metrics feed for one finished segment
+        (``metrics=True``): increment the process registry's engine
+        counters from the report's per-cause FailureCounts arrays, and
+        attach per-round CUMULATIVE counter rows (engine-lifetime, so
+        chunked drivers keep monotone counters across start() calls)
+        for the JSONL v7 ``metrics`` field. Never called from a traced
+        region — the metrics-in-trace lint rule and the
+        engine/metrics-on HLO identity pair both enforce that."""
+        from ..telemetry.metrics import observe_engine_run
+        sent = np.asarray(report.sent_per_round, np.int64)
+        failed = np.asarray(report.failed_per_round, np.int64)
+        if report.failed_per_cause is not None:
+            by_cause = {c: float(np.asarray(a).sum())
+                        for c, a in report.failed_per_cause.items()}
+        else:
+            by_cause = {"all": float(failed.sum())}
+        observe_engine_run(type(self).__name__, n_rounds,
+                           float(sent.sum()), by_cause)
+        base = self._metrics_base
+        sent_cum = base["sent"] + np.cumsum(sent)
+        failed_cum = base["failed"] + np.cumsum(failed)
+        stats["metrics_rows"] = [
+            {"rounds_total": base["rounds"] + i + 1,
+             "sent_total": int(sent_cum[i]),
+             "failed_total": int(failed_cum[i])}
+            for i in range(n_rounds)]
+        base["rounds"] += n_rounds
+        base["sent"] = int(sent_cum[-1]) if n_rounds else base["sent"]
+        base["failed"] = int(failed_cum[-1]) if n_rounds else base["failed"]
+        return stats
+
     def perf_summary(self) -> Optional[dict]:
         """The manifest/verdict ``perf`` block (None when ``perf=`` is
         off): banked program costs, the analytic cross-check, the last
@@ -2328,6 +2371,8 @@ class GossipSimulator(SimulationEventSender):
         # finishes — harvest the live timestamps only after that, or the
         # async dispatch would race the collection.
         report = self._build_report(stats)
+        if self.metrics_enabled:
+            stats = self._feed_metrics(dict(stats), report, n_rounds)
         live_times, self._live_round_times = self._live_round_times, None
         self.replay_events(first_round, stats, self._metric_keys(),
                            include_live=live_fallback)

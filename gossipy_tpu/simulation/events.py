@@ -103,6 +103,15 @@ class SimulationEventReceiver:
         they replay only (live receivers saw the round before its timing
         existed). Fired after ``update_chaos``."""
 
+    def update_metrics(self, round: int, metrics: dict) -> None:
+        """Per-round cumulative engine counters (fired only by runs with
+        ``metrics=`` enabled; see :mod:`gossipy_tpu.telemetry.metrics`).
+        ``metrics`` carries engine-LIFETIME monotone totals —
+        ``rounds_total``, ``sent_total``, ``failed_total`` — so a
+        tailing dashboard reads counters straight off the stream.
+        Host-derived after the segment finishes (like ``update_perf``),
+        so replay-only. Fired after ``update_perf``."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""
@@ -144,7 +153,8 @@ class SimulationEventSender:
                       probes: Optional[dict] = None,
                       health: Optional[dict] = None,
                       chaos: Optional[dict] = None,
-                      perf: Optional[dict] = None) -> None:
+                      perf: Optional[dict] = None,
+                      metrics: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
@@ -161,6 +171,8 @@ class SimulationEventSender:
                 r.update_chaos(round, chaos)
             if perf is not None:
                 r.update_perf(round, perf)
+            if metrics is not None:
+                r.update_metrics(round, metrics)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -208,6 +220,9 @@ class SimulationEventSender:
                       if k in stats}
         perf_arrs = {k: np.asarray(stats[k]) for k in PERF_STAT_KEYS
                      if k in stats}
+        # Host-assembled list of per-round dicts (engine metrics= feed);
+        # unlike the array stats above it never transits the device.
+        metrics_rows = stats.get("metrics_rows")
 
         def row(arr, i):
             vals = arr[i]
@@ -223,12 +238,15 @@ class SimulationEventSender:
                 {k: a[i] for k, a in health_arrs.items()})
             chaos = chaos_event_row({k: a[i] for k, a in chaos_arrs.items()})
             perf = perf_event_row({k: a[i] for k, a in perf_arrs.items()})
+            metrics = (metrics_rows[i]
+                       if metrics_rows is not None and i < len(metrics_rows)
+                       else None)
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
                                include_live=include_live, causes=causes,
                                probes=probes, health=health, chaos=chaos,
-                               perf=perf)
+                               perf=perf, metrics=metrics)
         if fire_end:
             self._notify_end()
 
@@ -326,6 +344,9 @@ class CallbackReceiver(SimulationEventReceiver):
     def update_perf(self, round, perf):
         self._row["perf"] = dict(perf)
 
+    def update_metrics(self, round, metrics):
+        self._row["metrics"] = dict(metrics)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = dict(metrics)
 
@@ -339,7 +360,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     any dashboard can tail the .jsonl (for a push-style sink — W&B,
     TensorBoard — use :class:`CallbackReceiver` instead).
 
-    Line schema (``"schema": 6``), one object per round — versions are
+    Line schema (``"schema": 7``), one object per round — versions are
     strictly additive, so a reader written against any version parses
     every later one by ignoring unknown keys (and every earlier one via
     :meth:`parse_line`, which fills absent fields with null):
@@ -391,6 +412,17 @@ class JSONLinesReceiver(SimulationEventReceiver):
                                     stream writes null here because the
                                     timing is host-derived after the
                                     segment)
+        v7      ``metrics``         cumulative engine-counter row
+                                    ``| null``: ``rounds_total``,
+                                    ``sent_total``, ``failed_total`` —
+                                    engine-LIFETIME monotone totals from
+                                    the SLO metrics feed (null without
+                                    ``metrics=``; replay-only, like
+                                    ``perf``). The final registry
+                                    snapshot itself travels as the
+                                    telemetry sink's terminal
+                                    ``metrics_snapshot`` event, not on
+                                    round rows
         ======= =================== =====================================
 
     Works replayed (default) or live (``live=True`` streams rows during the
@@ -403,7 +435,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
-    SCHEMA = 6
+    SCHEMA = 7
 
     def __init__(self, path: str, live: bool = False):
         import json
@@ -417,8 +449,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
         self._row = {"schema": self.SCHEMA, "round": round, "sent": sent,
                      "failed": failed, "failed_by_cause": None,
                      "size": size, "probes": None, "health": None,
-                     "chaos": None, "perf": None, "local": None,
-                     "global": None}
+                     "chaos": None, "perf": None, "metrics": None,
+                     "local": None, "global": None}
 
     def update_failure_causes(self, round, causes):
         self._row["failed_by_cause"] = dict(causes)
@@ -435,6 +467,9 @@ class JSONLinesReceiver(SimulationEventReceiver):
     def update_perf(self, round, perf):
         self._row["perf"] = dict(perf)
 
+    def update_metrics(self, round, metrics):
+        self._row["metrics"] = dict(metrics)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics
 
@@ -446,7 +481,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     @classmethod
     def parse_line(cls, line: str) -> dict:
-        """Version-tolerant row reader: normalize a v1..v6 line into
+        """Version-tolerant row reader: normalize a v1..v7 line into
         the CURRENT schema's shape (fields a line's version predates come
         back null, unknown future fields pass through untouched). The one
         reader consumers should use instead of re-encoding the version
@@ -464,6 +499,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
             row.setdefault("chaos", None)
         if schema < 6:
             row.setdefault("perf", None)
+        if schema < 7:
+            row.setdefault("metrics", None)
         return row
 
     def close(self):

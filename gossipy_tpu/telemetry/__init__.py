@@ -23,6 +23,12 @@ other way, so everything here is importable standalone):
   watermarks) the engines compute when ``sentinels=`` is set, plus the
   anomaly-triggered :class:`FlightRecorder` and its
   :func:`replay_bundle` deterministic-replay counterpart.
+- :mod:`.metrics` — the labeled SLO metrics registry
+  (Counter/Gauge/Histogram with log-spaced percentile estimation,
+  OpenMetrics export, associative cross-process snapshot merge) the
+  service scheduler and the engines feed HOST-side only — the tracelint
+  ``metrics-in-trace`` rule enforces the same never-in-a-trace contract
+  io_callback bodies live under.
 - :mod:`.cost` — :class:`PerfConfig` and the host-side performance
   observability layer (``perf=``): per-compiled-program
   :class:`CostReport` (XLA cost/memory analysis), the analytic
@@ -60,6 +66,17 @@ from .health import (
     replay_bundle,
 )
 from .manifest import MANIFEST_SCHEMA, RunManifest, git_revision
+from .metrics import (
+    DEFAULT_BUCKETS,
+    METRICS_SCHEMA,
+    MetricsRegistry,
+    get_registry,
+    merge_snapshots,
+    observe_engine_run,
+    quantile_from_counts,
+    set_registry,
+    snapshot_to_openmetrics,
+)
 from .probes import (
     PROBE_STAT_KEYS,
     ProbeAccum,
@@ -94,6 +111,10 @@ __all__ = [
     "FlightRecorder", "health_event_row", "health_round_stats",
     "localize_first_nonfinite", "nonfinite_counts", "nonfinite_total",
     "per_node_param_norm", "replay_bundle",
+    "MetricsRegistry", "METRICS_SCHEMA", "DEFAULT_BUCKETS",
+    "get_registry", "set_registry", "merge_snapshots",
+    "snapshot_to_openmetrics", "quantile_from_counts",
+    "observe_engine_run",
     "PerfConfig", "CostReport", "PEAK_FLOPS", "PERF_STAT_KEYS",
     "analytic_round_cost", "cost_report_for",
     "differential_phase_attribution", "mfu_estimate", "peak_flops",

@@ -129,6 +129,11 @@ def _config_snapshot(sim: Any) -> dict:
         # in the manifest's top-level ``perf`` block, not here).
         perf = sim.perf
         snap["perf"] = perf.to_dict() if perf is not None else None
+    if hasattr(sim, "metrics_enabled"):
+        # Whether this run fed the host-side SLO metrics registry
+        # (telemetry.metrics) — the counters themselves live in the
+        # process registry / its exported snapshots, not per run.
+        snap["metrics"] = bool(sim.metrics_enabled)
     return snap
 
 

@@ -87,9 +87,28 @@ class TelemetrySink:
         self._events.clear()
 
     def close(self) -> None:
-        """Close the JSONL mirror. When the ring evicted events, a final
-        ``sink_closed`` line records the loss in the mirror (the
-        in-memory tail cannot carry what it already dropped)."""
+        """Close the JSONL mirror. A terminal ``metrics_snapshot`` line
+        first carries the process metrics registry's final state into
+        the mirror (when any metric was recorded — a post-mortem reads
+        the run's SLO counters next to its last events; mirror-only, so
+        the live ring and its ``dropped_events`` accounting are
+        untouched), then, when the ring evicted events, a final
+        ``sink_closed`` line records the loss (the in-memory tail cannot
+        carry what it already dropped)."""
+        if self._fh is not None:
+            try:
+                from .metrics import get_registry
+                snap = get_registry().snapshot()
+                if snap["metrics"]:
+                    # Mirror-only on purpose: close() is terminal, so the
+                    # snapshot goes to the durable file, not the live
+                    # ring — appending to the ring here would evict real
+                    # trailing events and skew dropped_events.
+                    self._fh.write(json.dumps(TelemetryEvent(
+                        kind="metrics_snapshot",
+                        data={"snapshot": snap}).to_dict()) + "\n")
+            except Exception:  # a snapshot failure must never block close
+                pass
         if self._fh is not None:
             if self.dropped_events:
                 self._fh.write(json.dumps(TelemetryEvent(

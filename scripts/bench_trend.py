@@ -1,0 +1,166 @@
+"""bench_trend: fold bench rows into a trend ledger + regression gate.
+
+Reads every ``BENCH_r*.json`` driver capsule under ``--root`` (the
+``{"n": …, "parsed": <bench row>}`` files the PR driver banks) plus any
+``--row`` files (bare bench-row JSON — e.g. ``scripts/loadgen.py``'s
+``slo_row.json`` with the ``service_slo`` metric) and produces:
+
+- a BASELINE.md-ready markdown trend table, one section per metric,
+  rows grouped by backend (a CPU-degraded 44 r/s row must never be
+  "compared" against an accelerator 823 r/s row — cross-backend deltas
+  are environment noise, not regressions);
+- a regression gate: within each (metric, backend) group, the LATEST
+  non-degraded row is compared against the BEST prior non-degraded row;
+  a drop worse than ``--max-regress`` (default 15%) exits nonzero and
+  names the offender. Degraded rows are shown but never gate (their
+  label already says the measurement is not the real one).
+
+"Better" direction is per-metric: units measuring time (``ms``, ``s``,
+``seconds``) regress UP, everything else (rounds/s, tenants/hour,
+speedup factors, MFU fractions) regresses DOWN.
+
+Usage::
+
+    python scripts/bench_trend.py                      # repo root, print
+    python scripts/bench_trend.py --out trend.md
+    python scripts/bench_trend.py --row load-runs/slo_row.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_LOWER_BETTER_UNITS = {"s", "ms", "seconds", "milliseconds"}
+
+
+def lower_is_better(row: dict) -> bool:
+    unit = str(row.get("unit", "")).lower()
+    metric = str(row.get("metric", ""))
+    return unit in _LOWER_BETTER_UNITS or \
+        metric.endswith(("_seconds", "_ms"))
+
+
+def load_rows(root: str, extra: list) -> list:
+    """Every bench row found, as ``{"source", "order", "row"}`` dicts —
+    capsules sorted by their ``n``, extra rows appended after (they are
+    the freshest measurements)."""
+    out = []
+    capsules = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    for path in capsules:
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"[trend] skipping {path}: {e!r}", file=sys.stderr)
+            continue
+        row = doc.get("parsed")
+        if not isinstance(row, dict) or "metric" not in row:
+            continue
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        out.append({"source": os.path.basename(path),
+                    "order": int(m.group(1)) if m else 0, "row": row})
+    next_order = max((r["order"] for r in out), default=0) + 1
+    for path in extra:
+        doc = json.load(open(path))
+        row = doc.get("parsed", doc)   # capsule or bare row
+        if "metric" not in row:
+            raise SystemExit(f"--row {path}: not a bench row "
+                             "(no 'metric' field)")
+        out.append({"source": os.path.basename(path),
+                    "order": next_order, "row": row})
+        next_order += 1
+    return out
+
+
+def _group_key(row: dict) -> tuple:
+    raw = row.get("raw") or {}
+    return (row["metric"], str(raw.get("backend", "unrecorded")))
+
+
+def _degraded(row: dict) -> bool:
+    return bool((row.get("raw") or {}).get("degraded"))
+
+
+def analyze(entries: list, max_regress: float) -> tuple[str, list]:
+    """(markdown trend table, regression list). Regressions compare the
+    latest non-degraded row per (metric, backend) group against the best
+    prior non-degraded row in the same group."""
+    groups: dict[tuple, list] = {}
+    for e in entries:
+        groups.setdefault(_group_key(e["row"]), []).append(e)
+
+    lines = ["# Bench trend", ""]
+    regressions = []
+    for (metric, backend) in sorted(groups):
+        es = sorted(groups[(metric, backend)], key=lambda e: e["order"])
+        lines += [f"## {metric} ({backend})", "",
+                  "| source | value | unit | degraded | note |",
+                  "|---|---:|---|---|---|"]
+        clean = [e for e in es if not _degraded(e["row"])]
+        best_prior = None
+        if len(clean) >= 2:
+            prior = clean[:-1]
+            vals = [e["row"]["value"] for e in prior]
+            best_prior = (min(vals) if lower_is_better(clean[-1]["row"])
+                          else max(vals))
+        for e in es:
+            row = e["row"]
+            note = ""
+            if clean and e is clean[-1] and best_prior is not None:
+                lib = lower_is_better(row)
+                delta = (best_prior - row["value"]) / best_prior \
+                    if lib else (row["value"] - best_prior) / best_prior
+                note = f"{delta:+.1%} vs best prior ({best_prior})"
+                if delta < -max_regress:
+                    regressions.append(
+                        f"{metric} ({backend}): {e['source']} = "
+                        f"{row['value']} {row.get('unit', '')} is "
+                        f"{-delta:.1%} worse than best prior "
+                        f"{best_prior} (> {max_regress:.0%} budget)")
+                    note += "  **REGRESSION**"
+            reason = (row.get("raw") or {}).get("degrade_reason", "")
+            lines.append(
+                f"| {e['source']} | {row['value']} "
+                f"| {row.get('unit', '')} "
+                f"| {'yes — ' + reason if _degraded(row) else ''} "
+                f"| {note} |")
+        lines.append("")
+    if not groups:
+        lines.append("(no bench rows found)")
+    return "\n".join(lines) + "\n", regressions
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--row", action="append", default=[],
+                    help="extra bench-row JSON file (repeatable), e.g. "
+                         "loadgen's slo_row.json")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown table here (default: stdout)")
+    ap.add_argument("--max-regress", type=float, default=0.15,
+                    help="gate threshold as a fraction (default 0.15)")
+    args = ap.parse_args()
+
+    entries = load_rows(args.root, args.row)
+    table, regressions = analyze(entries, args.max_regress)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(table)
+        print(f"[trend] {len(entries)} row(s) -> {args.out}",
+              file=sys.stderr)
+    else:
+        print(table)
+    for r in regressions:
+        print(f"[trend] REGRESSION: {r}", file=sys.stderr)
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

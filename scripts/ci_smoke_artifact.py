@@ -9,7 +9,7 @@ simulation under the flight recorder and writes, into ``--out DIR``:
 - ``manifest.json`` — the run's :class:`RunManifest` (config, versions,
   backend, memory budget, probes, sentinels, sink counters, and the
   ``perf`` block — XLA cost/memory numbers + timing, null-safe on CPU),
-- ``events.jsonl`` — the schema-v6 per-round JSONL rows,
+- ``events.jsonl`` — the schema-v7 per-round JSONL rows,
 - ``bundle_*/`` — ONLY when the run trips a sentinel or raises: the
   flight-recorder repro bundle (checkpoint + manifest + verdict +
   trailing events), which the CI workflow uploads so a red smoke run

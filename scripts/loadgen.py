@@ -1,0 +1,136 @@
+"""Sustained mixed-shape arrival harness: the service SLO benchmark.
+
+Drives :func:`gossipy_tpu.service.slo.run_load` — Poisson tenant
+arrivals over a mixed-shape spec pool, served open-loop by an
+incremental :class:`~gossipy_tpu.service.scheduler.ServiceSession`
+(arrivals interleave with running buckets, so queue-wait and
+time-to-first-round are measured under real contention) — and emits the
+``service_slo`` bench row the ROADMAP's always-on-service item names as
+its "Done" evidence::
+
+    {"metric": "service_slo", "value": <tenants/hour>,
+     "unit": "tenants/hour",
+     "raw": {"tenants_per_hour", "ttfr_p50_ms", "ttfr_p99_ms",
+             "round_p50_ms", "round_p99_ms", "queue_wait_p99_ms",
+             "n_admitted", "ttfr_missing": [], ...}}
+
+Stdout carries the ONE row JSON line (bench.py's contract style); the
+human-readable account goes to stderr. Artifacts under ``--out``:
+per-tenant report/manifest/events (the normal service layout),
+``slo_row.json`` (the row), and ``metrics/metrics.json`` +
+``metrics/metrics.prom`` (registry snapshot + OpenMetrics export —
+tail the former live with ``scripts/service_top.py``).
+
+Exit status: 0 only when every tenant that was admitted finished (DONE
+or EVICTED) AND has a recorded time-to-first-round (the acceptance
+invariant); 1 otherwise.
+
+Usage::
+
+    python scripts/loadgen.py --out load-runs --tenants 6 --rate 1200
+    python scripts/loadgen.py --out load-runs --pool pool.json \
+        --tenants 20 --rate 600 --time-scale 0.01
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="load-runs",
+                    help="artifact root (service layout + slo_row.json)")
+    ap.add_argument("--pool", default=None,
+                    help="JSON file: list of ExperimentConfig template "
+                         "dicts (default: the built-in two-shape pool)")
+    ap.add_argument("--tenants", type=int, default=6,
+                    help="number of tenants to generate from the pool")
+    ap.add_argument("--rate", type=float, default=1200.0,
+                    help="offered Poisson arrival rate, tenants/hour")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="compress the arrival schedule by this factor "
+                         "(0.01 = 100x faster than nominal; reported "
+                         "offered rate is adjusted accordingly)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slice", type=int, default=3,
+                    help="rounds per cooperative scheduling slice")
+    ap.add_argument("--rounds", type=int, default=6,
+                    help="rounds per tenant (built-in pool only)")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="metrics snapshot/export dir "
+                         "(default: <out>/metrics)")
+    args = ap.parse_args()
+
+    from gossipy_tpu import enable_compilation_cache
+    enable_compilation_cache()
+    from gossipy_tpu.service.slo import default_spec_pool, run_load
+
+    if args.pool:
+        with open(args.pool) as fh:
+            pool = json.load(fh)
+        if not isinstance(pool, list) or not pool:
+            raise SystemExit(f"--pool {args.pool}: expected a non-empty "
+                             "JSON list of config dicts")
+    else:
+        pool = default_spec_pool(n_rounds=args.rounds)
+
+    metrics_dir = args.metrics_dir or os.path.join(args.out, "metrics")
+    result = run_load(args.out, pool=pool, n_tenants=args.tenants,
+                      rate_per_hour=args.rate, seed=args.seed,
+                      slice_rounds=args.slice, metrics_dir=metrics_dir,
+                      time_scale=args.time_scale)
+    row, queue = result["row"], result["queue"]
+    try:
+        # Backend stamp (bench.py emit() convention) so bench_trend
+        # groups this row with its hardware peers, not across backends.
+        import jax
+        row["raw"]["backend"] = jax.default_backend()
+        row["raw"]["device_kind"] = jax.devices()[0].device_kind
+    except Exception:
+        pass
+
+    for h in queue.handles():
+        ttfr = (f"{h.first_round_at - h.submitted_at:.3f}s"
+                if h.first_round_at is not None else "MISSING")
+        print(f"[loadgen] {h.tenant}: {h.status.value} "
+              f"({h.rounds_completed}/{h.request.rounds} rounds) "
+              f"ttfr={ttfr}", file=sys.stderr)
+    raw = row["raw"]
+    print(f"[loadgen] {raw['n_admitted']} admitted / "
+          f"{raw['n_failed']} failed-to-build in "
+          f"{raw['wall_seconds']}s -> {row['value']} tenants/hour, "
+          f"ttfr p99 {raw['ttfr_p99_ms']} ms, "
+          f"round p99 {raw['round_p99_ms']} ms", file=sys.stderr)
+    print(f"[loadgen] metrics: {metrics_dir}/metrics.json (+ .prom); "
+          f"tail with: python scripts/service_top.py {metrics_dir}",
+          file=sys.stderr)
+
+    row_path = os.path.join(args.out, "slo_row.json")
+    with open(row_path, "w") as fh:
+        json.dump(row, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(row))
+
+    # Acceptance invariant: every admitted tenant has a recorded TTFR
+    # and nothing failed outright.
+    ok = (not raw["ttfr_missing"]
+          and raw["n_admitted"] == raw["ttfr_recorded"]
+          and raw["n_failed"] == 0
+          and raw["n_admitted"] == raw["n_done"] + raw["n_evicted"])
+    if not ok:
+        print(f"[loadgen] SLO invariant violated: "
+              f"missing_ttfr={raw['ttfr_missing']} "
+              f"failed={raw['n_failed']}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

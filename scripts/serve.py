@@ -24,6 +24,9 @@ Usage::
 
     python scripts/serve.py specs/*.json --out runs/
     python scripts/serve.py all.json --out runs/ --slice 50
+    python scripts/serve.py all.json --out runs/ --metrics-dir runs/metrics
+    # ... and in another terminal:
+    python scripts/service_top.py runs/metrics
 """
 
 from __future__ import annotations
@@ -70,6 +73,11 @@ def main() -> int:
     ap.add_argument("--no-repro", action="store_true",
                     help="skip per-slice last-healthy host copies "
                          "(faster; evictions lose their repro bundles)")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="write the SLO metrics registry here: a fresh "
+                         "metrics.json snapshot every scheduling cycle "
+                         "(tail it with scripts/service_top.py) plus a "
+                         "final OpenMetrics metrics.prom export")
     args = ap.parse_args()
 
     # Shared persistent compilation cache across service processes: the
@@ -83,7 +91,8 @@ def main() -> int:
     queue = RunQueue()
     handles = [queue.submit(r) for r in requests]
     svc = GossipService(args.out, slice_rounds=args.slice,
-                        keep_repro=not args.no_repro)
+                        keep_repro=not args.no_repro,
+                        metrics_dir=args.metrics_dir)
     summary = svc.serve(queue)
 
     for h in handles:

@@ -9,7 +9,7 @@ Covers the ISSUE-9 acceptance criteria:
   quiet on the static-by-contract counterexamples;
 - the registry-completeness meta-test: an injected unregistered
   ``health_bogus`` per-round field is flagged, and a simulated JSONL
-  schema v7 bump without a ``parse_line`` branch trips the tolerance
+  schema v8 bump without a ``parse_line`` branch trips the tolerance
   rule;
 - suppression comments, the file pragma, and the baseline waive exactly
   what they claim;
@@ -159,6 +159,60 @@ def ok_optout(sim, state, key):
         assert len(fs) == 1 and fs[0].line == 4
 
 
+METRICS_IN_TRACE = '''
+import jax
+from .telemetry.metrics import get_registry
+
+def body(carry, x):
+    get_registry().counter("engine_rounds_total").inc()   # host sink!
+    return carry, x
+
+def drive(init):
+    return jax.lax.scan(body, init, None, length=2)
+'''
+
+METRICS_HOST_OK = '''
+import jax
+from .telemetry.metrics import get_registry
+
+def step(carry, _):
+    def cb(v):
+        # io_callback body: a host sink — metrics calls are the point.
+        get_registry().counter("engine_rounds_total").inc(float(v))
+    jax.experimental.io_callback(cb, None, carry, ordered=True)
+    return carry, ()
+
+def drive(init):
+    return jax.lax.scan(step, init, None, length=2)
+
+def host_report(n):
+    # Plain host code (never traced): also fine.
+    get_registry().counter("engine_rounds_total").inc(n)
+'''
+
+
+class TestMetricsInTrace:
+    def test_fires_on_registry_call_in_traced_region(self):
+        fs = lint({"gossipy_tpu/_mfire.py": METRICS_IN_TRACE})
+        assert rules_of(fs) == ["metrics-in-trace"]
+        assert all(f.path == "gossipy_tpu/_mfire.py" for f in fs)
+        assert "host-side sinks" in fs[0].message
+
+    def test_quiet_in_io_callback_and_host_code(self):
+        assert lint({"gossipy_tpu/_mquiet.py": METRICS_HOST_OK}) == []
+
+    def test_tree_is_clean(self):
+        # The standing invariant: the engine/scheduler feed the registry
+        # strictly host-side (post-run / post-slice), so the real tree
+        # has zero metrics-in-trace findings.
+        assert [f for f in lint() if f.rule == "metrics-in-trace"] == []
+
+    def test_suppressible_like_any_rule(self):
+        src = METRICS_IN_TRACE.replace(
+            "# host sink!", "# tracelint: disable=metrics-in-trace")
+        assert lint({"gossipy_tpu/_mfire.py": src}) == []
+
+
 class TestRegistryRules:
     def test_unregistered_per_round_field_is_flagged(self):
         eng_path = REPO / "gossipy_tpu" / "simulation" / "engine.py"
@@ -176,20 +230,20 @@ class TestRegistryRules:
 
     def test_schema_bump_without_parse_line_branch_is_flagged(self):
         ev_path = REPO / "gossipy_tpu" / "simulation" / "events.py"
-        src = ev_path.read_text().replace("SCHEMA = 6", "SCHEMA = 7")
-        assert "SCHEMA = 7" in src
+        src = ev_path.read_text().replace("SCHEMA = 7", "SCHEMA = 8")
+        assert "SCHEMA = 8" in src
         fs = lint({"gossipy_tpu/simulation/events.py": src})
         assert rules_of(fs) == ["schema-tolerance"]
-        assert "if schema < 7" in fs[0].message
+        assert "if schema < 8" in fs[0].message
 
     def test_schema_bump_with_branch_passes(self):
         ev_path = REPO / "gossipy_tpu" / "simulation" / "events.py"
-        src = ev_path.read_text().replace("SCHEMA = 6", "SCHEMA = 7")
+        src = ev_path.read_text().replace("SCHEMA = 7", "SCHEMA = 8")
         src = src.replace(
-            "        if schema < 6:",
-            "        if schema < 7:\n"
+            "        if schema < 7:",
+            "        if schema < 8:\n"
             "            row.setdefault(\"future\", None)\n"
-            "        if schema < 6:")
+            "        if schema < 7:")
         fs = lint({"gossipy_tpu/simulation/events.py": src})
         assert [f for f in fs if f.rule == "schema-tolerance"] == []
 

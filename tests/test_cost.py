@@ -209,7 +209,7 @@ class TestEngineIntegration:
         assert len(rows_cb) == 3
         assert all(r["perf"]["round_ms"] > 0 for r in rows_cb)
         lines = [JSONLinesReceiver.parse_line(l) for l in open(path)]
-        assert all(r["schema"] == 6 for r in lines)
+        assert all(r["schema"] == 7 for r in lines)
         assert all(r["perf"] is not None and r["perf"]["round_ms"] > 0
                    for r in lines)
 
@@ -437,7 +437,7 @@ class TestSchemaV6:
         v1 = json.dumps({"schema": 1, "round": 1, "sent": 1, "failed": 0,
                          "size": 2, "local": None, "global": None})
         assert JSONLinesReceiver.parse_line(v1)["perf"] is None
-        assert JSONLinesReceiver.SCHEMA == 6
+        assert JSONLinesReceiver.SCHEMA == 7  # v7: + "metrics"
 
     def test_report_from_dict_tolerates_missing_perf(self):
         rep = SimulationReport(metric_names=["accuracy"],

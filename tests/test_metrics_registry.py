@@ -1,0 +1,452 @@
+"""SLO metrics registry (telemetry.metrics) + the service signal plane.
+
+Covers the ISSUE-11 acceptance surface:
+
+- counter/gauge/histogram semantics, the zero-label sugar and the label
+  cardinality guard (overflow series aggregates, totals stay right);
+- percentile estimation accuracy against numpy on synthetic samples
+  (bounded by the ~1.78x log-bucket resolution, clamped to min/max);
+- OpenMetrics text golden + snapshot JSON round-trip;
+- cross-process merge associativity/commutativity and exact-sum
+  equivalence to a single-registry reference;
+- the engine's host-side ``metrics=`` feed (counters match the report,
+  JSONL v7 rows carry cumulative totals, v1–v7 parse_line tolerance);
+- the TelemetrySink terminal ``metrics_snapshot`` event;
+- loadgen end-to-end: N small Poisson-arriving tenants through the
+  incremental service session -> a sane ``service_slo`` row with every
+  admitted tenant's time-to-first-round recorded.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from gossipy_tpu.telemetry.metrics import (
+    DEFAULT_BUCKETS,
+    OVERFLOW_LABEL,
+    MetricsRegistry,
+    merge_snapshots,
+    quantile_from_counts,
+    set_registry,
+    snapshot_to_openmetrics,
+)
+
+
+@pytest.fixture
+def reg():
+    r = MetricsRegistry()
+    prev = set_registry(r)
+    yield r
+    set_registry(prev)
+
+
+class TestCounter:
+    def test_inc_accumulates(self, reg):
+        c = reg.counter("jobs_total", "jobs", ("kind",))
+        c.labels(kind="a").inc()
+        c.labels(kind="a").inc(2.5)
+        c.labels(kind="b").inc()
+        snap = reg.snapshot()["metrics"]["jobs_total"]
+        vals = {s["labels"]["kind"]: s["value"] for s in snap["series"]}
+        assert vals == {"a": 3.5, "b": 1.0}
+
+    def test_negative_inc_raises(self, reg):
+        c = reg.counter("x_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_zero_label_sugar_and_label_mismatch(self, reg):
+        c = reg.counter("plain_total")
+        c.inc()
+        assert reg.snapshot()["metrics"]["plain_total"]["series"][0][
+            "value"] == 1.0
+        labeled = reg.counter("lab_total", labelnames=("k",))
+        with pytest.raises(ValueError):
+            labeled.inc()          # labels declared: must use .labels()
+        with pytest.raises(ValueError):
+            labeled.labels(wrong="x")
+
+    def test_kind_and_labelname_mismatch_raise(self, reg):
+        reg.counter("m1", labelnames=("a",))
+        with pytest.raises(ValueError):
+            reg.gauge("m1")
+        with pytest.raises(ValueError):
+            reg.counter("m1", labelnames=("b",))
+
+
+class TestGauge:
+    def test_set_inc_dec(self, reg):
+        g = reg.gauge("temp")
+        g.set_value(4.0)
+        g.inc(2.0)
+        g.dec(1.0)
+        s = reg.snapshot()["metrics"]["temp"]["series"][0]
+        assert s["value"] == 5.0
+        assert s["ts"] > 0
+
+    def test_merge_is_last_writer_wins(self, reg):
+        a = MetricsRegistry()
+        b = MetricsRegistry()
+        a.gauge("v").set_value(1.0)
+        b.gauge("v").set_value(2.0)   # written later
+        m = merge_snapshots(a.snapshot(), b.snapshot())
+        assert m["metrics"]["v"]["series"][0]["value"] == 2.0
+        # Commutes: the later stamp wins regardless of argument order.
+        m2 = merge_snapshots(b.snapshot(), a.snapshot())
+        assert m2["metrics"]["v"]["series"][0]["value"] == 2.0
+
+
+class TestHistogram:
+    def test_counts_sum_and_bucket_assignment(self, reg):
+        h = reg.histogram("lat", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 50.0, 500.0):
+            h.observe(v)
+        s = reg.snapshot()["metrics"]["lat"]["series"][0]
+        assert s["counts"] == [1, 1, 1, 1]   # one per bucket + Inf
+        assert s["count"] == 4
+        assert s["sum"] == pytest.approx(555.5)
+        assert s["min"] == 0.5 and s["max"] == 500.0
+
+    def test_nan_observation_ignored(self, reg):
+        h = reg.histogram("lat2")
+        h.observe(float("nan"))
+        h.observe(1.0)
+        s = reg.snapshot()["metrics"]["lat2"]["series"][0]
+        assert s["count"] == 1 and math.isfinite(s["sum"])
+
+    def test_empty_quantile_is_none(self, reg):
+        assert reg.histogram("lat3").quantile(0.5) is None
+
+    @pytest.mark.parametrize("dist", ["loguniform", "lognormal", "const"])
+    def test_percentile_accuracy_vs_numpy(self, reg, dist):
+        rng = np.random.default_rng(7)
+        if dist == "loguniform":
+            samples = np.exp(rng.uniform(np.log(1e-3), np.log(50.0),
+                                         4000))
+        elif dist == "lognormal":
+            samples = rng.lognormal(mean=-2.0, sigma=1.5, size=4000)
+        else:
+            samples = np.full(100, 0.25)
+        h = reg.histogram("acc", labelnames=("d",)).labels(d=dist)
+        for v in samples:
+            h.observe(float(v))
+        # Accuracy is bounded by the log-bucket resolution: the estimate
+        # must land within one bucket step (x1.9 with slack) of numpy's
+        # answer, and inside the observed envelope.
+        for q in (0.5, 0.9, 0.99):
+            est = h.quantile(q)
+            true = float(np.quantile(samples, q))
+            assert est is not None
+            assert samples.min() <= est <= samples.max()
+            assert true / 1.9 <= est <= true * 1.9, (q, est, true)
+
+    def test_quantile_from_counts_standalone(self):
+        # The snapshot-side estimator (service_top's path) agrees with
+        # the live child's.
+        buckets = tuple(DEFAULT_BUCKETS)
+        counts = [0] * (len(buckets) + 1)
+        counts[10] = 100
+        est = quantile_from_counts(buckets, counts, 0.5)
+        assert buckets[9] <= est <= buckets[10]
+
+
+class TestCardinalityGuard:
+    def test_overflow_series_aggregates(self, reg):
+        c = reg.counter("per_tenant_total", labelnames=("tenant",),
+                        max_series=3)
+        for i in range(10):
+            c.labels(tenant=f"t{i}").inc()
+        snap = reg.snapshot()["metrics"]["per_tenant_total"]
+        assert snap["overflowed"] == 7
+        by = {s["labels"]["tenant"]: s["value"] for s in snap["series"]}
+        # 3 real series + ONE shared overflow child carrying t3..t9.
+        assert by[OVERFLOW_LABEL] == 7.0
+        assert sum(by.values()) == 10.0    # totals never lost
+        assert len(by) == 4
+
+
+class TestOpenMetrics:
+    def test_golden_text(self, reg):
+        reg.counter("runs_total", "runs completed",
+                    ("status",)).labels(status="done").inc(3)
+        reg.gauge("queue_depth", "pending runs").set_value(2)
+        h = reg.histogram("wait_seconds", "queue wait", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        got = reg.to_openmetrics()
+        assert got == (
+            "# HELP queue_depth pending runs\n"
+            "# TYPE queue_depth gauge\n"
+            "queue_depth 2\n"
+            "# HELP runs_total runs completed\n"
+            "# TYPE runs_total counter\n"
+            'runs_total{status="done"} 3\n'
+            "# HELP wait_seconds queue wait\n"
+            "# TYPE wait_seconds histogram\n"
+            'wait_seconds_bucket{le="0.1"} 1\n'
+            'wait_seconds_bucket{le="1"} 2\n'
+            'wait_seconds_bucket{le="+Inf"} 3\n'
+            "wait_seconds_sum 5.55\n"
+            "wait_seconds_count 3\n"
+            "# EOF\n")
+
+    def test_label_escaping_and_counter_suffix(self, reg):
+        reg.counter("odd", "x", ("msg",)).labels(msg='a"b\nc').inc()
+        text = reg.to_openmetrics()
+        assert 'odd_total{msg="a\\"b\\nc"} 1' in text
+
+    def test_snapshot_json_roundtrip(self, reg):
+        reg.counter("a_total").inc()
+        reg.histogram("h").observe(1.0)
+        snap = reg.snapshot()
+        back = json.loads(json.dumps(snap))
+        assert back == snap
+        assert snapshot_to_openmetrics(back) == reg.to_openmetrics()
+
+
+def _random_registry(events):
+    r = MetricsRegistry()
+    for kind, name, labels, v, ts in events:
+        if kind == "c":
+            r.counter(name, labelnames=tuple(labels)).labels(
+                **labels).inc(v)
+        elif kind == "g":
+            ch = r.gauge(name, labelnames=tuple(labels)).labels(**labels)
+            ch.value, ch.ts = v, ts
+        else:
+            r.histogram(name, labelnames=tuple(labels)).labels(
+                **labels).observe(v)
+    return r
+
+
+def _assert_snapshots_equal(a: dict, b: dict):
+    """Structural equality with float-sum tolerance: counter values and
+    histogram sums are compared approx (float addition re-associates to
+    a different last ulp), everything else exactly."""
+    assert sorted(a["metrics"]) == sorted(b["metrics"])
+    for name in a["metrics"]:
+        fa, fb = a["metrics"][name], b["metrics"][name]
+        assert fa["type"] == fb["type"]
+        assert [s["labels"] for s in fa["series"]] == \
+            [s["labels"] for s in fb["series"]]
+        for sa, sb in zip(fa["series"], fb["series"]):
+            if fa["type"] == "counter":
+                assert sb["value"] == pytest.approx(sa["value"])
+            elif fa["type"] == "histogram":
+                assert sb["counts"] == sa["counts"]
+                assert sb["count"] == sa["count"]
+                assert sb["sum"] == pytest.approx(sa["sum"])
+                assert sb["min"] == sa["min"] and sb["max"] == sa["max"]
+            else:
+                assert (sb["value"], sb["ts"]) == (sa["value"], sa["ts"])
+
+
+class TestMerge:
+    def _events(self, seed, n=120):
+        # Gauge stamps increase with event order so "last written" and
+        # "latest stamp" name the same value — the single-registry
+        # reference and the merge must then agree exactly.
+        rng = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            kind = ("c", "g", "h")[int(rng.integers(3))]
+            name = f"m{int(rng.integers(3))}_{kind}"
+            labels = {"k": f"v{int(rng.integers(4))}"}
+            out.append((kind, name, labels,
+                        float(rng.uniform(0.001, 100.0)), float(i)))
+        return out
+
+    def test_associative_and_commutative(self):
+        evs = self._events(0, 240)
+        parts = [_random_registry(evs[i::3]).snapshot() for i in range(3)]
+        a, b, c = parts
+        left = merge_snapshots(merge_snapshots(a, b), c)
+        right = merge_snapshots(a, merge_snapshots(b, c))
+        swapped = merge_snapshots(c, merge_snapshots(b, a))
+        for m in (right, swapped):
+            _assert_snapshots_equal(left, m)
+
+    def test_merge_equals_single_registry(self):
+        evs = self._events(1, 180)
+        whole = _random_registry(evs).snapshot()
+        halves = merge_snapshots(_random_registry(evs[::2]).snapshot(),
+                                 _random_registry(evs[1::2]).snapshot())
+        _assert_snapshots_equal(whole, halves)
+
+    def test_structural_mismatch_raises(self):
+        a = MetricsRegistry()
+        b = MetricsRegistry()
+        a.counter("m")
+        b.gauge("m")
+        with pytest.raises(ValueError):
+            merge_snapshots(a.snapshot(), b.snapshot())
+
+    def test_load_snapshot_folds_in(self):
+        a = MetricsRegistry()
+        a.counter("n_total").inc(2)
+        b = MetricsRegistry()
+        b.counter("n_total").inc(3)
+        a.load_snapshot(b.snapshot())
+        assert a.snapshot()["metrics"]["n_total"]["series"][0][
+            "value"] == 5.0
+
+
+class TestSinkTerminalSnapshot:
+    def test_close_writes_metrics_snapshot_to_mirror(self, reg,
+                                                     tmp_path):
+        from gossipy_tpu.telemetry import TelemetrySink
+        reg.counter("done_total").inc()
+        path = str(tmp_path / "ev.jsonl")
+        sink = TelemetrySink(maxlen=4, jsonl_path=path)
+        sink.emit("hello", {})
+        sink.close()
+        lines = [json.loads(l) for l in open(path)]
+        assert [l["kind"] for l in lines] == ["hello", "metrics_snapshot"]
+        snap = lines[-1]["data"]["snapshot"]
+        assert snap["metrics"]["done_total"]["series"][0]["value"] == 1.0
+        # Mirror-only: the live ring and its loss accounting are
+        # untouched by the terminal line.
+        assert [e.kind for e in sink.events()] == ["hello"]
+        assert sink.dropped_events == 0
+
+    def test_close_quiet_with_empty_registry(self, reg, tmp_path):
+        from gossipy_tpu.telemetry import TelemetrySink
+        path = str(tmp_path / "e.jsonl")
+        sink = TelemetrySink(jsonl_path=path)
+        sink.close()
+        assert open(path).read() == ""
+
+
+class TestJSONLSchemaV7:
+    def test_parse_line_v1_to_v7_roundtrip(self):
+        from gossipy_tpu.simulation.events import JSONLinesReceiver
+        assert JSONLinesReceiver.SCHEMA == 7
+        base = {"round": 1, "sent": 2, "failed": 0, "size": 4,
+                "local": None, "global": None}
+        v = dict(base)
+        by_version = {1: dict(v)}
+        for schema, field in ((2, "failed_by_cause"), (3, "probes"),
+                              (4, "health"), (5, "chaos"), (6, "perf"),
+                              (7, "metrics")):
+            v = dict(v)
+            v[field] = None
+            by_version[schema] = dict(v)
+        for schema, row in by_version.items():
+            row = dict(row, schema=schema)
+            parsed = JSONLinesReceiver.parse_line(json.dumps(row))
+            # Every version normalizes to the v7 shape: all fields
+            # present, absent ones null, nothing else invented.
+            for field in ("failed_by_cause", "probes", "health",
+                          "chaos", "perf", "metrics"):
+                assert field in parsed and parsed[field] is None
+            assert parsed["round"] == 1
+        # Unknown future fields pass through untouched.
+        v8 = dict(by_version[7], schema=8, shiny="new")
+        assert JSONLinesReceiver.parse_line(json.dumps(v8))["shiny"] \
+            == "new"
+
+
+@pytest.fixture
+def key():
+    import jax
+    return jax.random.PRNGKey(0)
+
+
+class TestEngineMetricsFeed:
+    def test_counters_match_report_and_jsonl_v7(self, reg, key, tmp_path):
+        from gossipy_tpu.analysis.hlo import _make_sim
+        from gossipy_tpu.simulation.events import JSONLinesReceiver
+        sim = _make_sim(metrics=True, drop_prob=0.2)
+        path = str(tmp_path / "run.jsonl")
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rx)
+            st = sim.init_nodes(key)
+            st, rep1 = sim.start(st, n_rounds=3, key=key)
+            st, rep2 = sim.start(st, n_rounds=2, key=key)
+        snap = reg.snapshot()["metrics"]
+        sent = (int(np.asarray(rep1.sent_per_round).sum())
+                + int(np.asarray(rep2.sent_per_round).sum()))
+        failed = rep1.failed_messages + rep2.failed_messages
+        assert snap["engine_rounds_total"]["series"][0]["value"] == 5
+        assert snap["engine_messages_sent_total"]["series"][0][
+            "value"] == sent
+        by_cause = {s["labels"]["cause"]: s["value"]
+                    for s in snap["engine_messages_failed_total"][
+                        "series"]}
+        assert sum(by_cause.values()) == failed
+        assert set(by_cause) == {"drop", "offline", "overflow"}
+        rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
+        assert [r["metrics"]["rounds_total"] for r in rows] == \
+            [1, 2, 3, 4, 5]
+        assert rows[-1]["metrics"]["sent_total"] == sent
+        assert rows[-1]["metrics"]["failed_total"] == failed
+
+    def test_metrics_off_feeds_nothing(self, reg, key):
+        from gossipy_tpu.analysis.hlo import _make_sim
+        sim = _make_sim()
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key)
+        assert reg.snapshot()["metrics"] == {}
+
+    @pytest.mark.slow
+    def test_metrics_on_is_hlo_neutral(self):
+        from gossipy_tpu.analysis import assert_identical_hlo
+        from gossipy_tpu.analysis.hlo import _make_sim
+        assert_identical_hlo(_make_sim(), _make_sim(metrics=True),
+                             label="metrics-on")
+
+
+class TestLoadgenEndToEnd:
+    def test_small_sustained_arrival_run(self, reg, tmp_path):
+        from gossipy_tpu.service.slo import run_load
+        pool = [dict(dataset="spambase", subsample=200, n_nodes=12,
+                     n_rounds=3, delta=20, batch_size=8,
+                     topology_params={"degree": 4}),
+                dict(dataset="spambase", subsample=200, n_nodes=14,
+                     n_rounds=3, delta=20, batch_size=8,
+                     topology_params={"degree": 4})]
+        result = run_load(str(tmp_path / "runs"), pool=pool, n_tenants=3,
+                          rate_per_hour=3600.0, seed=0, slice_rounds=2,
+                          metrics_dir=str(tmp_path / "metrics"),
+                          registry=reg, time_scale=0.001)
+        row, queue = result["row"], result["queue"]
+        raw = row["raw"]
+        assert row["metric"] == "service_slo"
+        assert row["unit"] == "tenants/hour"
+        # The acceptance trio, present and sane.
+        assert raw["tenants_per_hour"] > 0
+        assert raw["ttfr_p99_ms"] > 0
+        assert raw["round_p99_ms"] > 0
+        assert raw["ttfr_p50_ms"] <= raw["ttfr_p99_ms"]
+        # Every admitted tenant accounted for.
+        assert raw["n_admitted"] == 3
+        assert raw["n_failed"] == 0
+        assert raw["ttfr_missing"] == []
+        assert raw["ttfr_recorded"] == raw["n_admitted"]
+        for h in queue.handles():
+            assert h.first_round_at is not None
+            assert h.first_round_at >= h.submitted_at
+            m = json.load(open(h.artifacts["manifest"]))
+            slo = m["extra"]["service"]["slo"]
+            assert slo["ttfr_seconds"] is not None
+            assert slo["rounds_completed"] == 3
+        # The metrics artifacts the status board / scrapers consume.
+        snap = json.load(open(tmp_path / "metrics" / "metrics.json"))
+        assert snap["metrics"]["service_ttfr_seconds"]["series"]
+        om = (tmp_path / "metrics" / "metrics.prom").read_text()
+        assert om.endswith("# EOF\n")
+        assert "service_round_seconds_bucket" in om
+        # service_top renders a frame from the snapshot without error.
+        import importlib.util
+        import pathlib
+        spec = importlib.util.spec_from_file_location(
+            "service_top", pathlib.Path(__file__).resolve().parents[1]
+            / "scripts" / "service_top.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        frame = mod.render(snap, "metrics.json")
+        assert "tenants   admitted     3" in frame
+        assert "ttfr" in frame

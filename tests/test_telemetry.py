@@ -395,7 +395,7 @@ class TestReceivers:
         rows = [json.loads(l) for l in open(path)]
         assert len(rows) == 4
         for i, row in enumerate(rows):
-            assert row["schema"] == 6  # v6: + "perf" (null when off)
+            assert row["schema"] == 7  # v7: + "metrics" (null when off)
             assert set(row["failed_by_cause"]) == set(FAILURE_CAUSES)
             assert sum(row["failed_by_cause"].values()) == row["failed"]
             assert row["failed"] == rep.failed_per_round[i]
