@@ -825,6 +825,110 @@ def bench_scale(n_nodes: int = 50_000, rounds: int = 100) -> None:
     })
 
 
+def bench_cohort(nominal_n: int = 1_000_000, rounds: int = 50) -> None:
+    """Cohort row: active-cohort rounds/sec at NOMINAL ``nominal_n``.
+
+    The scale rows materialize every node (the 50k on-TPU wall,
+    ``BENCH_TPU_EVIDENCE.jsonl`` row 3); this row runs the same LogReg
+    round shape through ``simulation.cohort`` — the nominal population
+    lives as a host-resident pool and each round materializes only
+    ``$GOSSIPY_TPU_COHORT_SIZE`` nodes (default 1024) — so the metric is
+    per-round cost DECOUPLED from N. ``memory_budget``'s cohort-aware
+    accounting (``cohort_pool_resident`` vs ``cohort_active_total`` vs
+    the materialized prediction) is stamped into ``raw.*``.
+    """
+    import jax
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import CohortConfig, GossipSimulator, \
+        NominalTopology
+
+    cohort_size = int(os.environ.get("GOSSIPY_TPU_COHORT_SIZE", "1024"))
+    cohort_size = min(cohort_size, nominal_n)
+    d = 57
+    rng = np.random.default_rng(42)
+    w = rng.normal(size=d)
+    # Data bank: P = 4C shards; node i reads shard i % P (at nominal 10M
+    # nobody stacks 10M distinct shards — the bank is part of the
+    # cohort scaling story, not a shortcut).
+    pool_shards = min(nominal_n, 4 * cohort_size)
+    X = rng.normal(size=(4 * pool_shards, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    eval_cap = min(2048, int(0.2 * len(X)))
+    disp = DataDispatcher(
+        ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
+        n=pool_shards, eval_on_user=False)
+
+    def stamp(phase):
+        print(f"[cohort] {time.strftime('%H:%M:%S')} {phase}",
+              file=sys.stderr, flush=True)
+
+    handler = SGDHandler(model=LogisticRegression(d, 2),
+                         loss=losses.cross_entropy,
+                         optimizer=optax.sgd(0.1),
+                         local_epochs=1, batch_size=4, n_classes=2,
+                         input_shape=(d,),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    stamp(f"building cohort simulator (nominal {nominal_n}, C "
+          f"{cohort_size})")
+    sim = GossipSimulator(handler, NominalTopology(nominal_n),
+                          disp.stacked(), delta=ROUND_LEN,
+                          protocol=AntiEntropyProtocol.PUSH,
+                          sampling_eval=0.01, eval_every=rounds,
+                          history_dtype=HISTORY_DTYPE,
+                          cohort=CohortConfig(size=cohort_size), perf=True)
+    budget = sim.memory_budget()
+    stamp("cohort budget: pool "
+          f"{budget['cohort_pool_resident'] / 2**20:.1f}MB resident, "
+          f"active {budget['cohort_active_total'] / 2**20:.1f}MB, "
+          "materialized prediction "
+          f"{budget['cohort_materialized_prediction'] / 2**20:.1f}MB")
+    key = jax.random.PRNGKey(42)
+    stamp("init_cohort_pool")
+    t_pool = time.perf_counter()
+    pool = sim.init_cohort_pool(key)
+    pool_s = time.perf_counter() - t_pool
+    stamp(f"compile+first {rounds}-round segment loop")
+    pool, _ = sim.start(pool, n_rounds=rounds, key=key)
+    stamp("timed run")
+    t0 = time.perf_counter()
+    pool, report = sim.start(pool, n_rounds=rounds, key=key)
+    elapsed = time.perf_counter() - t0
+    stamp("done")
+    stamp_perf(sim)
+    emit_manifest(sim, "cohort")
+    rate = rounds / elapsed
+    cov = float(report.cohort_coverage[-1])
+    print(f"[cohort] nominal {nominal_n}: pool init {pool_s:.2f}s, "
+          f"{rounds} rounds at {rate:.1f} r/s, coverage {cov:.4f}",
+          file=sys.stderr)
+    emit({
+        "metric": f"cohort_rounds_per_sec_{nominal_n}nominal",
+        "value": round(rate, 2),
+        "unit": "rounds/s",
+        "vs_baseline": None,
+        "raw": {
+            **PERF_INFO,
+            "nominal_n": nominal_n,
+            "cohort_size": cohort_size,
+            "rounds": rounds,
+            "pool_init_seconds": round(pool_s, 2),
+            "pool_bytes": budget["cohort_pool_resident"],
+            "active_bytes": budget["cohort_active_total"],
+            "materialized_prediction_bytes":
+                budget["cohort_materialized_prediction"],
+            "pool_coverage_final": round(cov, 6),
+            "note": "per-round cost is a function of C, not N: the "
+                    "materialized engine cannot build this row at all "
+                    "past ~50k nodes on one chip",
+        },
+    })
+
+
 def bench_scale_all2all(n_nodes: int = 50_000, rounds: int = 50) -> None:
     """Variant scale row: Koloskova All-to-All (mixing merge) rounds/sec at
     ``n_nodes`` over a :class:`SparseTopology` with O(E) ``SparseMixing``
@@ -1414,6 +1518,12 @@ modes (default: the 100-node north-star, ours vs the live reference):
                             one-einsum merge: the engine's MFU upper end)
   --scale [N]               N-node rounds/s over a CSR SparseTopology
   --scale-all2all [N]       Koloskova variant at N nodes, sparse mixing
+  --cohort [N]              active-cohort rounds/s at NOMINAL N (default
+                            1M): resident pool + sampled [C]-wide rounds
+                            (simulation.cohort); C via
+                            GOSSIPY_TPU_COHORT_SIZE (default 1024); raw
+                            carries pool_bytes vs active_bytes vs the
+                            materialized prediction
   --fused-regime [ROUNDS]   pallas fused merge vs XLA gather+blend
   --ring-attn [S]           flash-attention kernel vs XLA dense attention
   --to-acc TARGET           wall-clock to reach TARGET global accuracy
@@ -1483,6 +1593,9 @@ def main():
     elif "--scale" in sys.argv:
         mode, mode_arg = "scale", _mode_arg("--scale", default=50_000,
                                             minimum=2)
+    elif "--cohort" in sys.argv:
+        mode, mode_arg = "cohort", _mode_arg("--cohort",
+                                             default=1_000_000, minimum=2)
     elif "--fused-regime" in sys.argv:
         mode, mode_arg = "fused", _mode_arg("--fused-regime", default=40,
                                             minimum=1)
@@ -1504,6 +1617,10 @@ def main():
         # Two 100-round passes over N nodes: scale the budget with N
         # (500k nodes measured at 0.10 r/s -> ~2000s of healthy work).
         deadline = 1500.0 + 0.025 * mode_arg
+    elif mode == "cohort":
+        # Rounds are C-wide (cheap); only the pool init/gathers scale
+        # with nominal N, and linearly at small constant.
+        deadline = 1500.0 + 2.5e-4 * mode_arg
     elif mode == "fused":
         deadline = 2400.0  # two full CNN-clique compiles + 2x2 passes
     elif mode in ("mfu", "mfu-wide", "mfu-reps", "mfu-all2all"):
@@ -1540,6 +1657,9 @@ def main():
         return
     if mode == "scale":
         bench_scale(mode_arg)
+        return
+    if mode == "cohort":
+        bench_cohort(mode_arg)
         return
     if mode == "scale-all2all":
         bench_scale_all2all(mode_arg)

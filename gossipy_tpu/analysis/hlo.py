@@ -198,6 +198,12 @@ def gate_cases() -> dict:
          lambda: _make_sim(), lambda: _make_sim(sentinels=None)),
         ("engine/chaos-off",
          lambda: _make_sim(), lambda: _make_sim(chaos=None)),
+        # Active-cohort mode off must be ABSENT: cohort=None builds the
+        # byte-identical materialized round program (cohort ON is a
+        # different world — host-driven [C] segments — so only the off
+        # identity is meaningful here).
+        ("engine/cohort-off",
+         lambda: _make_sim(), lambda: _make_sim(cohort=None)),
         ("engine/perf-off",
          lambda: _make_sim(), lambda: _make_sim(perf=None)),
         # perf is host-side only, so even perf ON must be HLO-neutral —

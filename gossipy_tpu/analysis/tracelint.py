@@ -135,7 +135,7 @@ _METHOD_DENYLIST = {
     "total",
 }
 
-_STAT_KEY_RE = re.compile(r"^(probe|health|chaos|perf)_[a-z0-9_]+$")
+_STAT_KEY_RE = re.compile(r"^(probe|health|chaos|perf|cohort)_[a-z0-9_]+$")
 _SUPPRESS_RE = re.compile(r"#\s*tracelint:\s*disable=([a-z\-,\s]+|all)")
 _SUPPRESS_FILE_RE = re.compile(
     r"#\s*tracelint:\s*disable-file=([a-z\-,\s]+|all)")

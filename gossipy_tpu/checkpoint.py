@@ -17,7 +17,20 @@ Usage::
 
 Multi-host note: orbax handles sharded arrays natively — a SimState whose
 node axis is sharded over a mesh (gossipy_tpu/parallel) checkpoints and
-restores with its shardings when ``template`` carries them.
+restores with its shardings when ``template`` carries them. Mesh restores
+place leaves per the partition-rule registry (``parallel/rules.py``) via
+``GossipSimulator.load(mesh=)`` — placement is derived, never
+hand-assembled here.
+
+Cohort-mode note: with ``cohort=`` the checkpoint unit is the resident
+:class:`~gossipy_tpu.simulation.cohort.CohortPool` (host numpy leaves,
+nominal-N sized) instead of a SimState — the same ``save_checkpoint`` /
+``restore_checkpoint`` pair round-trips it, and
+``GossipSimulator.load`` uses the cheap zero-filled
+``cohort.pool_template`` as the restore template so restores stay
+O(pool bytes) with no O(N) init compute. A restored pool continues
+bit-for-bit: cohort draws key on ``(key, absolute round)`` and the
+round counter is part of the pool.
 
 Compatibility note: a restore target must be built with the SAME simulator
 configuration, including ``mailbox_slots`` — the mailbox is a [D, N, K]

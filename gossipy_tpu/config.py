@@ -227,6 +227,14 @@ def _simulator(cfg: "ExperimentConfig", handler, topology, data):
         # so a typo'd chaos spec fails at build, not deep in a trace.
         from .simulation.faults import ChaosConfig
         common["chaos"] = ChaosConfig.from_dict(cfg.chaos)
+    if cfg.cohort is not None:
+        # Same early-validation discipline; only the base engine drives
+        # the resident-pool segment loop.
+        if cfg.simulator != "gossip":
+            raise ValueError("cohort mode requires simulator 'gossip' "
+                             f"(got {cfg.simulator!r})")
+        from .simulation.cohort import CohortConfig
+        common["cohort"] = CohortConfig.from_dict(cfg.cohort)
     common.update(cfg.simulator_params)
     kind = cfg.simulator
     if kind == "gossip":
@@ -351,6 +359,12 @@ class ExperimentConfig:
     chaos: Optional[dict] = None         # ChaosConfig.to_dict() form:
                                          # scheduled outages/partitions/
                                          # churn/spikes (simulation.faults)
+    cohort: Optional[dict] = None        # CohortConfig.to_dict() form:
+                                         # sampled active-cohort mode
+                                         # (simulation.cohort) — n_nodes
+                                         # becomes the NOMINAL population,
+                                         # each round materializes only
+                                         # cohort["size"] nodes
     sampling_eval: float = 0.0
     sync: bool = True
     eval_every: int = 1
@@ -374,6 +388,10 @@ class ExperimentConfig:
                              "(one user-row per node, MF factors travel)")
         if self.task != "recsys" and self.handler == "mf":
             raise ValueError("handler 'mf' requires task 'recsys'")
+        if self.cohort is not None and self.repetitions > 1:
+            raise ValueError("cohort mode is host-driven per segment and "
+                             "cannot ride the repetition vmap; run seeds "
+                             "as separate experiments")
 
     # -- serialization ------------------------------------------------------
 
@@ -592,5 +610,8 @@ def run_experiment(cfg: ExperimentConfig, data: Optional[tuple] = None):
         keys = jax.random.split(key, cfg.repetitions)
         return sim.run_repetitions(cfg.n_rounds, keys,
                                    common_init=cfg.common_init)
+    if getattr(sim, "cohort", None) is not None:
+        pool = sim.init_cohort_pool(key, common_init=cfg.common_init)
+        return sim.start(pool, n_rounds=cfg.n_rounds, key=key)
     state = sim.init_nodes(key, common_init=cfg.common_init)
     return sim.start(state, n_rounds=cfg.n_rounds, key=key)

@@ -25,13 +25,30 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..simulation.engine import SimState
+from . import rules
+from .rules import (  # re-exported: the registry is the placement API
+    DATA_RULES,
+    DCN_AXIS,
+    MODEL_AXIS,
+    NODE_AXIS,
+    RuleSpec,
+    STATE_RULES,
+    UnmatchedLeafError,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    partition_specs,
+)
 
-NODE_AXIS = "nodes"
-DCN_AXIS = "dcn"
-MODEL_AXIS = "model"
+__all__ = [
+    "NODE_AXIS", "DCN_AXIS", "MODEL_AXIS",
+    "STATE_RULES", "DATA_RULES", "RuleSpec", "UnmatchedLeafError",
+    "match_partition_rules", "partition_specs", "make_shard_and_gather_fns",
+    "init_distributed", "make_mesh", "make_mesh_2d", "make_mesh_tp",
+    "state_shardings", "shard_state", "shard_data",
+]
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -180,125 +197,52 @@ def make_mesh_tp(n_node_devices: int, n_model_devices: int,
                 axis_names)
 
 
-def _spec_for_rank(lead_axis_pos: int, ndim: int, axis_name) -> P:
-    """PartitionSpec placing ``axis_name`` (a mesh axis name or a tuple of
-    them, for 2-D meshes) at position ``lead_axis_pos``."""
-    dims = [None] * ndim
-    dims[lead_axis_pos] = axis_name
-    return P(*dims)
-
-
-def _node_axis_entry(mesh: Mesh, axis_name):
-    """The PartitionSpec entry for the node dimension.
-
-    ``axis_name=None`` (the default) derives it from the mesh: the single
-    axis of a 1-D mesh, or ALL axes combined on a multi-axis mesh (the node
-    population spans hosts x chips). An explicitly passed ``axis_name`` is
-    honored verbatim — a caller with a custom multi-axis mesh can pin the
-    node dimension to one axis.
-    """
-    if axis_name is not None:
-        return axis_name
-    # A "model" axis is tensor parallelism, never part of the node dimension.
-    names = tuple(a for a in mesh.axis_names if a != MODEL_AXIS)
-    assert names, "mesh has only a model axis; no axis left for nodes"
-    if len(names) > 1:
-        return names
-    return names[0]
-
-
-def _model_axis_entry(mesh: Mesh, model_axis):
-    """The mesh axis used for tensor parallelism, or None.
-
-    ``model_axis=None`` auto-detects: a mesh axis named ``"model"`` enables
-    TP; any other mesh is node-parallel only.
-    """
-    if model_axis is not None:
-        return model_axis
-    return MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
-
-
-def _param_spec(leaf, node_pos: int, node_entry, mesh: Mesh, model_entry) -> P:
-    """PartitionSpec for a parameter leaf: node axis at ``node_pos``, plus —
-    when TP is on — the largest trailing dimension divisible by the model
-    axis size sharded over it (ties broken toward the last dimension, where
-    flax dense kernels put features)."""
-    dims: list = [None] * leaf.ndim
-    dims[node_pos] = node_entry
-    if model_entry is not None:
-        size = mesh.shape[model_entry]
-        cands = [i for i in range(node_pos + 1, leaf.ndim)
-                 if leaf.shape[i] >= size and leaf.shape[i] % size == 0]
-        if cands and size > 1:
-            dims[max(cands, key=lambda i: (leaf.shape[i], i))] = model_entry
-    return P(*dims)
+# Mesh-axis resolution lives in the rule registry; the underscored names
+# remain as aliases for existing callers (collectives' shard_map specs).
+_node_axis_entry = rules.node_axis_entry
+_model_axis_entry = rules.model_axis_entry
 
 
 def state_shardings(state: SimState, mesh: Mesh,
-                    axis_name=None, model_axis=None) -> SimState:
-    """A SimState-shaped pytree of NamedShardings.
+                    axis_name=None, model_axis=None,
+                    batch_dims: int = 0) -> SimState:
+    """A SimState-shaped pytree of NamedShardings, DERIVED from the
+    partition-rule registry (:data:`~gossipy_tpu.parallel.rules.
+    STATE_RULES`) — this function owns no placement decisions of its own:
 
-    - model / phase leaves: node axis leading -> ``P("nodes", ...)``
-    - history / mailbox leaves: ``[D, N, ...]`` -> ``P(None, "nodes", ...)``
+    - model / phase / aux leaves: node axis leading -> ``P("nodes", ...)``
+    - history / mailbox leaves (incl. the int8 scale sidecars):
+      ``[D, N, ...]`` -> ``P(None, "nodes", ...)``
     - scalars (round counter): replicated
     - on a TP mesh (an axis named ``"model"``, or ``model_axis=...``):
       parameter, optimizer-state, and history-snapshot leaves additionally
       shard their largest eligible non-node dimension over the model axis
+
+    ``batch_dims`` shifts every node position right by that many leading
+    lane axes — the seed/tenant-vmapped megabatch placement (the service
+    scheduler passes 1). An unmatched state leaf raises
+    :class:`~gossipy_tpu.parallel.rules.UnmatchedLeafError`.
     """
-    entry = _node_axis_entry(mesh, axis_name)
-    model_entry = _model_axis_entry(mesh, model_axis)
-
-    def _shard(leaf, pos, model):
-        if not hasattr(leaf, "ndim") or leaf.ndim <= pos:
-            return NamedSharding(mesh, P())
-        return NamedSharding(mesh, _param_spec(leaf, pos, entry, mesh, model))
-
-    def shard(leaf, pos):
-        return _shard(leaf, pos, None)
-
-    def shard_param(leaf, pos):
-        return _shard(leaf, pos, model_entry)
-
-    model_sh = state.model._replace(
-        params=jax.tree.map(lambda l: shard_param(l, 0), state.model.params),
-        opt_state=jax.tree.map(lambda l: shard_param(l, 0),
-                               state.model.opt_state),
-        n_updates=jax.tree.map(lambda l: shard(l, 0), state.model.n_updates),
-    )
-    phase_sh = shard(state.phase, 0)
-    hist_p_sh = jax.tree.map(lambda l: shard_param(l, 1), state.history_params)
-    hist_a_sh = shard(state.history_ages, 1)
-    mb_sh = jax.tree.map(lambda l: shard(l, 1), state.mailbox)
-    rb_sh = jax.tree.map(lambda l: shard(l, 1), state.reply_box)
-    aux_sh = jax.tree.map(lambda l: shard(l, 0), state.aux)
-    # int8 ring sidecar: [D, N] per leaf — node axis at position 1, like
-    # the history ring itself (empty tuple for fp32/bf16 rings).
-    hist_s_sh = jax.tree.map(lambda l: shard(l, 1), state.history_scale)
-    return SimState(model=model_sh, phase=phase_sh,
-                    history_params=hist_p_sh, history_ages=hist_a_sh,
-                    mailbox=mb_sh, reply_box=rb_sh,
-                    round=NamedSharding(mesh, P()),
-                    aux=aux_sh, history_scale=hist_s_sh)
+    return rules.named_shardings(state, mesh, rules=STATE_RULES,
+                                 axis_name=axis_name, model_axis=model_axis,
+                                 batch_dims=batch_dims)
 
 
 def shard_state(state: SimState, mesh: Mesh,
-                axis_name=None, model_axis=None) -> SimState:
-    """Place a SimState onto the mesh, node axis sharded (plus model axes on
-    a TP mesh)."""
-    return jax.device_put(state,
-                          state_shardings(state, mesh, axis_name, model_axis))
+                axis_name=None, model_axis=None,
+                batch_dims: int = 0) -> SimState:
+    """Place a SimState onto the mesh per the rule registry (node axis
+    sharded, plus model axes on a TP mesh)."""
+    return jax.device_put(state, state_shardings(state, mesh, axis_name,
+                                                 model_axis, batch_dims))
 
 
-def shard_data(data: dict, mesh: Mesh, axis_name=None) -> dict:
-    """Shard stacked data: per-node arrays over the node axis, the global
-    eval set replicated."""
-    entry = _node_axis_entry(mesh, axis_name)
-    out = {}
-    for k, v in data.items():
-        arr = jax.numpy.asarray(v)
-        if k in ("x_eval", "y_eval"):
-            out[k] = jax.device_put(arr, NamedSharding(mesh, P()))
-        else:
-            out[k] = jax.device_put(
-                arr, NamedSharding(mesh, _spec_for_rank(0, arr.ndim, entry)))
-    return out
+def shard_data(data: dict, mesh: Mesh, axis_name=None,
+               batch_dims: int = 0) -> dict:
+    """Shard stacked data per the registry's :data:`DATA_RULES`: per-node
+    arrays over the node axis, the global eval set replicated."""
+    arrs = {k: jax.numpy.asarray(v) for k, v in data.items()}
+    shardings = rules.named_shardings(arrs, mesh, rules=DATA_RULES,
+                                      axis_name=axis_name,
+                                      batch_dims=batch_dims)
+    return {k: jax.device_put(arrs[k], shardings[k]) for k in arrs}

@@ -33,9 +33,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from . import _node_axis_entry
+from .rules import node_leading_spec, replicated_spec
 
 
 def _ring_perm(d: int):
@@ -106,9 +107,11 @@ def ring_all_gather(x: jax.Array, mesh: Mesh,
 
     # Every device assembles the identical full array, but replication via a
     # ppermute ring is not statically inferable — skip the varying-axes check.
+    # I/O specs derive from the rule registry's primitives: the input is
+    # node-leading, the gathered output replicated (parallel/rules.py).
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=P(axis_name, *([None] * (x.ndim - 1))),
-             out_specs=P(*([None] * x.ndim)), check_vma=False)
+             in_specs=node_leading_spec(x.ndim, axis_name),
+             out_specs=replicated_spec(x.ndim), check_vma=False)
     def body(chunk):
         me = jax.lax.axis_index(axis_name)
 
@@ -143,8 +146,9 @@ def ring_mixed_matmul(w: jax.Array, x: jax.Array, mesh: Mesh,
     nl = n // d
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(axis_name, None), P(axis_name, None)),
-             out_specs=P(axis_name, None))
+             in_specs=(node_leading_spec(2, axis_name),
+                       node_leading_spec(2, axis_name)),
+             out_specs=node_leading_spec(2, axis_name))
     def body(w_rows, chunk):
         me = jax.lax.axis_index(axis_name)
 
@@ -203,11 +207,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     NEG = jnp.asarray(-1e30, jnp.float32)  # finite: exp() stays nan-free
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(axis_name, None),) * 3,
+             in_specs=(node_leading_spec(2, axis_name),) * 3,
              # The pallas hop kernel's interpreter mode does not thread
              # varying-axes types onto in-kernel constants, so the vma
              # check only runs on the jnp path.
-             out_specs=P(axis_name, None), check_vma=not flash)
+             out_specs=node_leading_spec(2, axis_name), check_vma=not flash)
     def body(q_l, k_l, v_l):
         me = jax.lax.axis_index(axis_name)
 

@@ -1,0 +1,309 @@
+"""Regex partition-rule registry: every sharding in the repo, derived.
+
+Before this module, node-axis placement was hand-assembled per state group
+inside ``parallel.state_shardings`` — a new state leaf (an aux cache, a
+quantization sidecar) silently fell through to whatever the nearest
+``tree.map`` happened to do, and nothing failed when a leaf went unmatched.
+At million-node populations that is exactly the wrong failure mode: one
+replicated ``[D, N, ...]`` ring leaf is the difference between fitting and
+OOM.
+
+This module is the single source of placement truth (the
+``match_partition_rules`` / ``make_shard_and_gather_fns`` pattern of the
+pjit-at-scale codebases — SNIPPETS.md [1]/[3]; "Scalable Training of
+Language Models using JAX pjit and TPUv4"):
+
+- a **rule table**: ordered ``(path regex, RuleSpec)`` pairs over slash-
+  joined pytree leaf paths (``model/params/Dense_0/kernel``,
+  ``mailbox/sender``, ``history_scale/...``). First match wins; an
+  unmatched leaf RAISES — the coverage contract a test can enforce.
+- a **RuleSpec**: where the node axis sits on the leaf (``node_pos``),
+  whether the leaf is eligible for model-axis tensor parallelism
+  (``tp``), or replicated outright. The spec is resolved against a
+  concrete mesh + leaf shape into a ``jax.sharding.PartitionSpec`` —
+  shape-dependent choices (which dimension takes the model axis) live in
+  ONE resolver instead of being re-derived per call site.
+- ``make_shard_and_gather_fns``: per-leaf shard (host -> mesh placement)
+  and gather (mesh -> replicated) closures, the public API for moving a
+  resident pool or checkpoint leaf-by-leaf without materializing the
+  whole tree on one device.
+
+``parallel.state_shardings`` / ``shard_data``, checkpoint mesh-restores
+(``GossipSimulator.load(mesh=)``) and the service scheduler's megabatch
+placement (``GossipService(mesh=)``) all derive from this table; no
+hand-placed ``PartitionSpec`` exists outside this module (tracked by
+``tests/test_rules.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+DCN_AXIS = "dcn"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleSpec:
+    """Placement of one leaf family.
+
+    - ``node_pos``: index of the leaf dimension carrying the node
+      population (``None`` = fully replicated). A leaf with fewer than
+      ``node_pos + 1`` dimensions resolves to replicated (scalar leaves
+      in an otherwise node-leading family).
+    - ``tp``: on a tensor-parallel mesh (an axis named ``"model"``), also
+      shard the largest eligible trailing dimension over the model axis
+      (parameter/optimizer/ring-snapshot leaves; metadata stays node-only).
+    """
+
+    node_pos: Optional[int] = 0
+    tp: bool = False
+
+    def describe(self) -> str:
+        if self.node_pos is None:
+            return "replicated"
+        return (f"node_axis@{self.node_pos}" + ("+tp" if self.tp else ""))
+
+
+REPLICATED = RuleSpec(node_pos=None)
+
+# The SimState rule table. Paths are slash-joined leaf key paths rooted at
+# the SimState fields (NamedTuple attributes become path components, dict
+# keys likewise). ORDER MATTERS: first match wins. Every family the engine
+# or an in-tree variant can put into a SimState must match a rule — adding
+# a state field without a rule fails `match_partition_rules` (and the
+# coverage test) instead of silently replicating a [D, N, ...] array.
+STATE_RULES: tuple[tuple[str, RuleSpec], ...] = (
+    # Per-node model: params + optimizer state take the model axis on a TP
+    # mesh; the update counter is bookkeeping.
+    (r"^model/params(/|$)", RuleSpec(node_pos=0, tp=True)),
+    (r"^model/opt_state(/|$)", RuleSpec(node_pos=0, tp=True)),
+    (r"^model/n_updates(/|$)", RuleSpec(node_pos=0)),
+    # Per-node timing (sync offset / async period).
+    (r"^phase$", RuleSpec(node_pos=0)),
+    # History ring [D, N, ...]: snapshots are params-shaped past the two
+    # leading axes -> TP-eligible; ages and the int8 scale sidecars
+    # ([D, N(, extra)] per leaf) are node-only.
+    (r"^history_params(/|$)", RuleSpec(node_pos=1, tp=True)),
+    (r"^history_ages$", RuleSpec(node_pos=1)),
+    (r"^history_scale(/|$)", RuleSpec(node_pos=1)),
+    # Mailbox metadata [D, N, K] (push/pull and reply traffic).
+    (r"^(mailbox|reply_box)/", RuleSpec(node_pos=1)),
+    # Round counter: replicated scalar.
+    (r"^round$", REPLICATED),
+    # Variant aux state (token balances, neighbor caches, PENS counters,
+    # cohort tables): leading node axis by contract (engine.py SimState).
+    (r"^aux(/|$)", RuleSpec(node_pos=0)),
+)
+
+# Stacked-data rule table (DataDispatcher.stacked() dicts): the global
+# eval split is replicated (every node scores the same set), everything
+# else is per-node and sharded on its leading axis.
+DATA_RULES: tuple[tuple[str, RuleSpec], ...] = (
+    (r"^(x_eval|y_eval)$", REPLICATED),
+    (r"^", RuleSpec(node_pos=0)),
+)
+
+
+def _key_name(entry) -> str:
+    """One path component from a jax key-path entry (attr names for
+    NamedTuples, dict keys, sequence indices)."""
+    name = getattr(entry, "name", None)
+    if name is not None:
+        return str(name)
+    key = getattr(entry, "key", None)
+    if key is not None:
+        return str(key)
+    idx = getattr(entry, "idx", None)
+    if idx is not None:
+        return str(idx)
+    return str(entry)
+
+
+def leaf_path(path) -> str:
+    """Slash-joined name of a jax key path (the rule-matching string)."""
+    return "/".join(_key_name(e) for e in path)
+
+
+def named_leaves(tree) -> list[tuple[str, object]]:
+    """``(path, leaf)`` pairs for every leaf, with slash-joined paths."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(leaf_path(p), leaf) for p, leaf in flat]
+
+
+class UnmatchedLeafError(ValueError):
+    """A pytree leaf no partition rule covers — the coverage contract.
+
+    Raised instead of silently replicating: at population scale an
+    unplaced ``[D, N, ...]`` leaf is an OOM, not a fallback.
+    """
+
+
+def match_partition_rules(rules, tree, *, prefix: str = ""):
+    """A tree of :class:`RuleSpec` matching ``tree``'s structure.
+
+    Each leaf's slash-joined path (optionally prefixed) is matched against
+    the ordered ``(regex, RuleSpec)`` table with ``re.search``; first
+    match wins. An unmatched leaf raises :class:`UnmatchedLeafError`
+    naming the path and the table — coverage is a hard contract, not a
+    default.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def pick(path, leaf):
+        name = prefix + leaf_path(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                return spec
+        raise UnmatchedLeafError(
+            f"no partition rule matches leaf {name!r}; add a rule to the "
+            "table (parallel/rules.py) — unmatched leaves are an error, "
+            "not a replicate-by-default")
+
+    return jax.tree_util.tree_map_with_path(pick, tree)
+
+
+# -- mesh-axis resolution (the mesh half of a rule) --------------------------
+
+def node_axis_entry(mesh: Mesh, axis_name=None):
+    """The PartitionSpec entry for the node dimension.
+
+    ``axis_name=None`` derives it from the mesh: the single axis of a 1-D
+    mesh, or ALL non-model axes combined (the node population spans
+    hosts x chips). An explicit ``axis_name`` is honored verbatim.
+    """
+    if axis_name is not None:
+        return axis_name
+    names = tuple(a for a in mesh.axis_names if a != MODEL_AXIS)
+    assert names, "mesh has only a model axis; no axis left for nodes"
+    if len(names) > 1:
+        return names
+    return names[0]
+
+
+def model_axis_entry(mesh: Mesh, model_axis=None):
+    """The mesh axis used for tensor parallelism, or None. Auto-detects an
+    axis named ``"model"`` when not given explicitly."""
+    if model_axis is not None:
+        return model_axis
+    return MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+
+
+def node_leading_spec(ndim: int, entry, pos: int = 0) -> P:
+    """PartitionSpec with the node entry at ``pos``, rest replicated —
+    the registry's primitive every resolved spec is built from (also used
+    directly by the explicit collectives for their shard_map I/O specs)."""
+    dims: list = [None] * ndim
+    if pos < ndim:
+        dims[pos] = entry
+    return P(*dims)
+
+
+def replicated_spec(ndim: int) -> P:
+    """Fully-replicated PartitionSpec of rank ``ndim``."""
+    return P(*([None] * ndim))
+
+
+def resolve_spec(rule: RuleSpec, leaf, mesh: Mesh, node_entry,
+                 model_entry=None, batch_dims: int = 0) -> P:
+    """Resolve one rule against a concrete leaf + mesh into a
+    PartitionSpec.
+
+    ``batch_dims`` shifts the node position right by that many leading
+    axes — the seed/tenant-vmapped megabatch case, where every leaf gains
+    a leading [T] lane axis that stays replicated.
+
+    TP resolution (``rule.tp`` on a mesh with a model axis): the largest
+    trailing dimension divisible by the model-axis size takes it (ties
+    toward the last dimension, where flax dense kernels put features).
+    """
+    ndim = getattr(leaf, "ndim", 0)
+    if rule.node_pos is None:
+        return replicated_spec(ndim)
+    pos = rule.node_pos + batch_dims
+    if ndim <= pos:
+        return replicated_spec(ndim)
+    dims: list = [None] * ndim
+    dims[pos] = node_entry
+    if rule.tp and model_entry is not None:
+        size = mesh.shape[model_entry]
+        cands = [i for i in range(pos + 1, ndim)
+                 if leaf.shape[i] >= size and leaf.shape[i] % size == 0]
+        if cands and size > 1:
+            dims[max(cands, key=lambda i: (leaf.shape[i], i))] = model_entry
+    return P(*dims)
+
+
+def partition_specs(tree, mesh: Mesh, rules=STATE_RULES, axis_name=None,
+                    model_axis=None, batch_dims: int = 0):
+    """``tree``-shaped pytree of PartitionSpecs: match the rule table,
+    resolve each rule against the mesh and leaf shape."""
+    node_entry = node_axis_entry(mesh, axis_name)
+    model_entry = model_axis_entry(mesh, model_axis)
+    rule_tree = match_partition_rules(rules, tree)
+    return jax.tree.map(
+        lambda leaf, rule: resolve_spec(rule, leaf, mesh, node_entry,
+                                        model_entry, batch_dims),
+        tree, rule_tree)
+
+
+def named_shardings(tree, mesh: Mesh, rules=STATE_RULES, axis_name=None,
+                    model_axis=None, batch_dims: int = 0):
+    """``tree``-shaped pytree of NamedShardings (resolved rule table)."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        partition_specs(tree, mesh, rules, axis_name,
+                                        model_axis, batch_dims))
+
+
+def make_shard_and_gather_fns(tree, mesh: Mesh, rules=STATE_RULES,
+                              axis_name=None, model_axis=None,
+                              batch_dims: int = 0):
+    """Per-leaf shard/gather closures from the resolved rule table
+    (SNIPPETS.md [1]/[3] ``make_shard_and_gather_fns``).
+
+    Returns ``(shard_fns, gather_fns)``, two pytrees matching ``tree``:
+
+    - ``shard_fns`` leaf: ``fn(array) -> array`` placed per its rule
+      (``jax.device_put`` with the resolved NamedSharding) — apply
+      leaf-by-leaf to stream a host-resident pool or checkpoint onto the
+      mesh without staging the whole tree on one device.
+    - ``gather_fns`` leaf: ``fn(array) -> np.ndarray`` fully gathered to
+      replicated host memory — the inverse, for checkpointing or host
+      inspection of a sharded leaf.
+    """
+    import numpy as np
+    shardings = named_shardings(tree, mesh, rules, axis_name, model_axis,
+                                batch_dims)
+
+    def make_shard(sh):
+        return lambda x: jax.device_put(x, sh)
+
+    def make_gather(sh):
+        del sh
+        return lambda x: np.asarray(jax.device_get(x))
+
+    return (jax.tree.map(make_shard, shardings),
+            jax.tree.map(make_gather, shardings))
+
+
+def rules_table(rules=STATE_RULES) -> list[list[str]]:
+    """The rule table as ``[pattern, placement]`` string rows — the
+    manifest stamp (:class:`~gossipy_tpu.telemetry.RunManifest` records
+    which placement registry produced a run's shardings)."""
+    return [[pat, spec.describe()] for pat, spec in rules]
+
+
+def resolved_rules_table(tree, rules=STATE_RULES) -> list[list[str]]:
+    """Leaf-resolved table: ``[leaf path, placement]`` for every leaf of
+    ``tree`` under ``rules`` (raises on an unmatched leaf). The audit
+    view: exactly where every state array of THIS simulator lands."""
+    rule_tree = match_partition_rules(rules, tree)
+    return [[path, spec.describe()]
+            for (path, _), (_, spec) in zip(named_leaves(tree),
+                                            named_leaves(rule_tree))]

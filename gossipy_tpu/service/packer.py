@@ -129,6 +129,13 @@ def shape_signature(request: RunRequest, sim: Any) -> ShapeSignature:
                       if sim.sentinels is not None else None),
         "topology": _topology_digest(sim.topology),
         "data_shapes": _data_shapes(sim.data),
+        # Cohort geometry: spec.py rejects cohort requests today (the
+        # pool loop is host-driven), but the signature still covers it so
+        # a future cohort-capable scheduler can never fuse two tenants
+        # whose round programs differ in cohort width / peer mode
+        # (getattr-guarded like chaos, for pre-cohort engines).
+        "cohort": (sim.cohort.to_dict()
+                   if getattr(sim, "cohort", None) is not None else None),
         # Chaos: schedule array SHAPES and the static trace facts split
         # buckets; the schedule VALUES are tenant-variable and ride the
         # batch axis (the scheduler rebinds sim.chaos_schedule per lane,

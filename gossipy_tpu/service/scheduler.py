@@ -129,8 +129,10 @@ class _BucketRuntime:
 
     def __init__(self, bucket: Bucket, out_root: str, slice_rounds: int,
                  keep_repro: bool, events_jsonl: bool,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 mesh=None):
         self.bucket = bucket
+        self.mesh = mesh
         self._reg = registry if registry is not None else get_registry()
         self._m = _service_metrics(self._reg)
         self._digest8 = bucket.signature.digest[:8]
@@ -284,7 +286,19 @@ class _BucketRuntime:
         t0 = time.perf_counter()
         self._init_fn = self._make_init()
         self._step_fn = self._make_step()
+        if self.mesh is not None:
+            # Megabatch placement derives from the partition-rule
+            # registry (parallel/rules.py): the stacked [T, ...] tenant
+            # data and the [T, N, ...] state batch shard their node axis
+            # per the same table as solo runs — batch_dims=1 shifts every
+            # rule's node position past the replicated lane axis.
+            from ..parallel import shard_data
+            self.data = shard_data(self.data, self.mesh, batch_dims=1)
         self.states = self._init_fn(self.keys, self.data)
+        if self.mesh is not None:
+            from ..parallel import shard_state
+            self.states = shard_state(self.states, self.mesh,
+                                      batch_dims=1)
         jax.block_until_ready(jax.tree.leaves(self.states)[0])
         self._m["compile"].labels(bucket=self._digest8,
                                   program="init").set_value(
@@ -664,7 +678,13 @@ class GossipService:
                  keep_repro: bool = True, sentinels_default: bool = True,
                  events_jsonl: bool = True,
                  metrics_dir: Optional[str] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 mesh=None):
+        # Optional jax.sharding.Mesh: when given, every bucket's
+        # megabatch state/data placement is derived from the partition-
+        # rule registry (parallel/rules.py) instead of single-device
+        # default placement — the multi-chip service path.
+        self.mesh = mesh
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         self.slice_rounds = int(slice_rounds)
@@ -758,7 +778,7 @@ class ServiceSession:
         })
         new = [_BucketRuntime(b, svc.out_dir, svc.slice_rounds,
                               svc.keep_repro, svc.events_jsonl,
-                              registry=svc.registry)
+                              registry=svc.registry, mesh=svc.mesh)
                for b in buckets]
         for rt in new:
             rt.initialize()

@@ -82,6 +82,11 @@ class RunRequest:
                 "request per seed — the packer fuses them into one "
                 "program anyway); got repetitions="
                 f"{self.config.repetitions}")
+        if self.config.cohort is not None:
+            raise ValueError(
+                "cohort mode is a host-driven resident-pool segment loop "
+                "(simulation.cohort) — it cannot ride the megabatch vmap; "
+                "run it solo via run_experiment()")
 
     @property
     def rounds(self) -> int:

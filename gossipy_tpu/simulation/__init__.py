@@ -1,5 +1,6 @@
 """Simulation runtime (reference gossipy/simul.py re-designed for TPU)."""
 
+from .cohort import CohortConfig, CohortPool, NominalTopology
 from .engine import GossipSimulator, Mailbox, SimState
 from .faults import (
     ChaosConfig,
@@ -46,4 +47,5 @@ __all__ = [
     "ChaosConfig", "OutageEpisode", "PartitionEpisode", "ChurnProcess",
     "FaultSpike", "FaultSchedule", "build_fault_schedule",
     "rounds_to_reconverge",
+    "CohortConfig", "CohortPool", "NominalTopology",
 ]

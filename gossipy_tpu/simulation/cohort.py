@@ -1,0 +1,616 @@
+"""Sampled active-cohort rounds: population size decoupled from round cost.
+
+The engine materializes every node every round — state is ``[N, ...]``,
+the round program is ``[N]``-wide, and the 50k-node TPU run already dies
+(``BENCH_TPU_EVIDENCE.jsonl`` row 3). "Millions of users" needs the
+cross-device-FL shape instead (the actor/learner split of the Podracer
+architectures, PAPERS.md): the full population of NOMINAL size N lives as
+a host-resident pool of per-node durable state, and each round only a
+sampled **cohort** of C nodes is materialized — gather the cohort's
+state, run the standard jitted round program at shape ``[C, ...]``,
+scatter the updates back. Per-round cost (compute, HBM, compile) is a
+function of C; N only prices the pool.
+
+    sim = GossipSimulator(handler, topology, data,
+                          cohort=CohortConfig(size=4096))
+    pool = sim.init_cohort_pool(key)
+    pool, report = sim.start(pool, n_rounds=500, key=key)
+
+What persists per node across rounds is the pool
+(:class:`CohortPool`): model params + optimizer state + update counts,
+the phase/period, a per-node PRNG key, and the touched-mask the coverage
+accounting reads. Round-scoped state (mailbox, params-history ring,
+reply box) is rebuilt per cohort from the gathered params — cohort
+rotation drains in-flight traffic, one of the documented bias caveats
+(docs/scale.md) vs full-population gossip.
+
+Peer sampling inside a cohort round (``CohortConfig.peer_mode``):
+
+- ``"resample"`` (default): peers drawn uniformly over the active cohort
+  — the cross-device-FL reading where the round's participants gossip
+  among themselves. No O(N) topology structure is ever touched, so this
+  is the 10M-node path (pair it with :class:`NominalTopology` to skip
+  building a graph at all).
+- ``"induced"``: the topology-induced subgraph on the cohort, via the
+  existing :class:`~gossipy_tpu.core.SparseTopology` neighbor-table
+  machinery — each cohort node may only contact its real neighbors that
+  are ALSO in the cohort (others' sends are skipped like isolated
+  nodes). Exact subset semantics; at C << N most nodes are isolated, so
+  this mode is for cohorts a sizable fraction of N.
+
+``cohort=None`` (the default) traces the byte-identical round program —
+the ``engine/cohort-off`` identity pair in ``analysis/hlo.py``'s gate
+enforces it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Report keys this layer adds (registered in report.PER_ROUND_FIELDS; the
+# tracelint registry-field rule covers the cohort_ prefix).
+COHORT_STAT_KEYS = ("cohort_coverage", "cohort_active_nodes")
+
+_PEER_MODES = ("resample", "induced")
+
+
+@dataclasses.dataclass(frozen=True)
+class CohortConfig:
+    """Active-cohort mode configuration.
+
+    - ``size``: C, the number of nodes materialized per round.
+    - ``rounds_per_cohort``: how many consecutive rounds one sampled
+      cohort runs before rotating (1 = fresh cohort every round, the
+      cross-device-FL default). Larger values amortize the gather/scatter
+      against more in-cohort mixing.
+    - ``peer_mode``: ``"resample"`` | ``"induced"`` (module doc).
+    """
+
+    size: int
+    rounds_per_cohort: int = 1
+    peer_mode: str = "resample"
+
+    def __post_init__(self):
+        if int(self.size) < 2:
+            raise ValueError(f"cohort size must be >= 2, got {self.size}")
+        if int(self.rounds_per_cohort) < 1:
+            raise ValueError("rounds_per_cohort must be >= 1, got "
+                             f"{self.rounds_per_cohort}")
+        if self.peer_mode not in _PEER_MODES:
+            raise ValueError(f"unknown peer_mode {self.peer_mode!r}; "
+                             f"options: {_PEER_MODES}")
+
+    @staticmethod
+    def coerce(value: Union[None, int, dict, "CohortConfig"]
+               ) -> Optional["CohortConfig"]:
+        """None | C | dict | CohortConfig -> Optional[CohortConfig]."""
+        if value is None or isinstance(value, CohortConfig):
+            return value
+        if isinstance(value, bool):
+            raise ValueError("cohort= takes a size/config, not a bool")
+        if isinstance(value, int):
+            return CohortConfig(size=value)
+        if isinstance(value, dict):
+            return CohortConfig.from_dict(value)
+        raise ValueError(f"cannot coerce {type(value).__name__} to "
+                         "CohortConfig")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "CohortConfig":
+        fields = {f.name for f in dataclasses.fields(CohortConfig)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(f"unknown cohort fields: {sorted(unknown)}; "
+                             f"valid: {sorted(fields)}")
+        return CohortConfig(**d)
+
+
+class NominalTopology:
+    """A population SIZE pretending to be a topology.
+
+    Resample-mode cohorts never read edges, so a 10M-node run should not
+    pay for (or even build) a 10M-node graph. This stand-in carries only
+    ``num_nodes``; every structural query raises, which also guarantees
+    it cannot silently reach a code path that needs real edges
+    (``peer_mode="induced"``, chaos, the non-cohort engine).
+    """
+
+    def __init__(self, n: int):
+        self.num_nodes = int(n)
+
+    def __getattr__(self, name):
+        raise AttributeError(
+            f"NominalTopology has no {name!r}: it is a population size "
+            "for resample-mode cohort runs, not a graph — use a real "
+            "Topology/SparseTopology for edge-dependent features")
+
+    def __repr__(self):
+        return f"NominalTopology({self.num_nodes})"
+
+
+class _CohortRoundTopology:
+    """The inner round's C-node 'everyone may talk to everyone' world.
+
+    ``sample_peers`` draws one uniform peer != self per node WITHOUT
+    materializing a [C, C] adjacency (a clique at C=65536 would be 4 GB):
+    ``peer_i = (i + 1 + U{0..C-2}) % C``. Expected fan-in is exactly
+    ``F`` per node; the engine's mailbox/compaction sizing reads that
+    through ``GossipSimulator._expected_fanin_vector``'s cohort branch.
+    """
+
+    def __init__(self, c: int):
+        self.num_nodes = int(c)
+        self.degrees = np.full(self.num_nodes, self.num_nodes - 1,
+                               dtype=np.int64)
+
+    def sample_peers(self, key: jax.Array) -> jax.Array:
+        c = self.num_nodes
+        r = jax.random.randint(key, (c,), 0, c - 1, dtype=jnp.int32)
+        return (jnp.arange(c, dtype=jnp.int32) + 1 + r) % c
+
+    def __repr__(self):
+        return f"_CohortRoundTopology({self.num_nodes})"
+
+
+class CohortPool(NamedTuple):
+    """The resident per-node durable state of the nominal population.
+
+    Every array leaf has leading axis N (host numpy by default — the pool
+    is the thing that must NOT live in the round program's HBM budget).
+    ``model`` is the stacked :class:`~gossipy_tpu.handlers.base.
+    ModelState`; ``node_key`` the per-node PRNG key table the init drew
+    from (gathered/scattered with the cohort so a node's identity
+    survives checkpoints); ``touched`` the coverage-accounting mask;
+    ``round`` the absolute round counter (round randomness keys off it,
+    so a restored pool continues bit-for-bit).
+    """
+
+    model: Any
+    phase: Any
+    node_key: Any
+    touched: Any
+    round: Any
+
+
+def setup_cohort(sim, topology):
+    """Constructor-side wiring (called from ``GossipSimulator.__init__``
+    when ``cohort=`` is given): validate the combination, remember the
+    nominal population, and hand back the C-node inner round topology the
+    rest of construction sizes against."""
+    from .engine import GossipSimulator
+
+    if type(sim) is not GossipSimulator:
+        raise ValueError(
+            f"cohort mode supports the base GossipSimulator only; "
+            f"{type(sim).__name__} variants drive their own state shapes")
+    cfg: CohortConfig = sim.cohort
+    n = int(topology.num_nodes)
+    if cfg.size > n:
+        raise ValueError(f"cohort size {cfg.size} exceeds the nominal "
+                         f"population {n}")
+    sim.nominal_topology = topology
+    sim.nominal_n = n
+    sim._cohort_nbr_global = None
+    if cfg.peer_mode == "induced":
+        if isinstance(topology, NominalTopology):
+            raise ValueError("peer_mode='induced' needs a real topology "
+                             "(NominalTopology carries no edges)")
+        from .nodes import build_neighbor_table
+        sim._cohort_nbr_global = np.asarray(build_neighbor_table(topology),
+                                            dtype=np.int32)
+    return _CohortRoundTopology(cfg.size)
+
+
+def induced_peers(sim, state, key: jax.Array) -> jax.Array:
+    """Uniform peer draw over the cohort-induced subgraph: the cohort-
+    local neighbor table rides ``state.aux["cohort_nbr"]`` ([C, max_deg],
+    -1 = absent or not-in-cohort), so the compiled program is reused
+    across cohorts — the table is data, not a trace constant. Nodes with
+    no alive cohort neighbor get peer -1 (send skipped, like isolated
+    nodes)."""
+    nbr = state.aux["cohort_nbr"]
+    alive = nbr >= 0
+    logits = jnp.where(alive, 0.0, -jnp.inf)
+    slot = jax.random.categorical(key, logits, axis=-1)
+    has = alive.any(axis=-1)
+    c = nbr.shape[0]
+    peers = nbr[jnp.arange(c), jnp.clip(slot, 0, nbr.shape[1] - 1)]
+    return jnp.where(has, peers, -1).astype(jnp.int32)
+
+
+# -- pool construction -------------------------------------------------------
+
+def _leaf_np(shape_dtype, n: int) -> np.ndarray:
+    return np.empty((n,) + tuple(shape_dtype.shape),
+                    dtype=np.dtype(shape_dtype.dtype))
+
+
+def _model_shape(sim):
+    return jax.eval_shape(sim.handler.init, jax.random.PRNGKey(0))
+
+
+def pool_template(sim) -> CohortPool:
+    """A zero-filled, correctly-shaped pool — the checkpoint-restore
+    template (orbax needs structure + dtypes, not values), cheap even at
+    nominal 10M (plain numpy zeros, no per-node init)."""
+    n = sim.nominal_n
+    st = _model_shape(sim)
+    model = jax.tree.map(
+        lambda l: np.zeros((n,) + tuple(l.shape), np.dtype(l.dtype)), st)
+    key_t = np.zeros_like(
+        np.asarray(jax.random.split(jax.random.PRNGKey(0), 2))[:1]
+        .repeat(n, axis=0))
+    return CohortPool(model=model,
+                      phase=np.zeros(n, np.int32),
+                      node_key=key_t,
+                      touched=np.zeros(n, bool),
+                      # 0-d ndarray, not a numpy scalar: orbax's restore-
+                      # args builder only types ndarrays.
+                      round=np.zeros((), np.int32))
+
+
+def init_cohort_pool(sim, key: jax.Array, common_init: bool = False,
+                     local_train: bool = False,
+                     block: Optional[int] = None) -> CohortPool:
+    """Initialize the resident pool (the cohort-mode ``init_nodes``).
+
+    Per-node model init runs in device blocks of ``block`` nodes
+    (default ``max(C, 65536)``) so nominal-10M pools never materialize
+    the whole population on one device at once — each block's leaves are
+    copied straight into preallocated host numpy.
+
+    ``local_train`` defaults to **False** (unlike ``init_nodes``): the
+    reference's init-time local pass would gather every node's data shard
+    at pool scale. With it off, a node takes its first local update the
+    first time it is sampled into a cohort — a documented bias vs the
+    materialized engine (docs/scale.md). Pass ``True`` to pay the
+    blocked pre-training pass anyway.
+    """
+    n = sim.nominal_n
+    cfg = sim.cohort
+    block = int(block or max(cfg.size, 65536))
+    k_init, k_phase, k_up = jax.random.split(key, 3)
+    node_keys = np.asarray(jax.random.split(k_init, n))
+
+    st_shape = _model_shape(sim)
+    model = jax.tree.map(lambda l: _leaf_np(l, n), st_shape)
+    flat_model = jax.tree.leaves(model)
+
+    if common_init:
+        one = jax.tree.map(np.asarray, sim.handler.init(k_init))
+        for dst, src in zip(flat_model, jax.tree.leaves(one)):
+            dst[...] = src[None]
+    else:
+        init_block = jax.jit(jax.vmap(sim.handler.init))
+        for lo in range(0, n, block):
+            hi = min(lo + block, n)
+            blk = init_block(jnp.asarray(node_keys[lo:hi]))
+            for dst, src in zip(flat_model, jax.tree.leaves(blk)):
+                dst[lo:hi] = np.asarray(src)
+
+    if local_train:
+        p = _pool_data_rows(sim)
+        upd_block = jax.jit(jax.vmap(sim.handler.update))
+        for lo in range(0, n, block):
+            hi = min(lo + block, n)
+            idx = np.arange(lo, hi)
+            sub = jax.tree.map(lambda l: jnp.asarray(l[lo:hi]), model)
+            data = tuple(jnp.asarray(d)[jnp.asarray(idx % p)]
+                         for d in (np.asarray(sim.data["xtr"]),
+                                   np.asarray(sim.data["ytr"]),
+                                   np.asarray(sim.data["mtr"])))
+            keys = jax.random.split(jax.random.fold_in(k_up, lo), hi - lo)
+            out = upd_block(sub, data, keys)
+            for dst, src in zip(flat_model, jax.tree.leaves(out)):
+                dst[lo:hi] = np.asarray(src)
+
+    if sim.sync:
+        phase = np.asarray(jax.random.randint(
+            k_phase, (n,), 0, sim.delta, dtype=jnp.int32))
+    else:
+        raw = sim.delta + (sim.delta / 10.0) * np.asarray(
+            jax.random.normal(k_phase, (n,)))
+        phase = np.maximum(raw.astype(np.int32), 1)
+
+    return _host_pool(CohortPool(model=model, phase=phase,
+                                 node_key=node_keys,
+                                 touched=np.zeros(n, bool),
+                                 round=np.zeros((), np.int32)))
+
+
+def _host_pool(pool: CohortPool, copy: bool = False) -> CohortPool:
+    """Normalize a pool to WRITABLE host numpy leaves (jax exports and
+    orbax restores can hand back read-only buffers; the scatter half of
+    the segment loop writes in place). ``copy=True`` copies every leaf —
+    ``cohort_start`` uses it so the caller's pool keeps its value
+    semantics (a FlightRecorder's "last healthy state" reference must
+    not alias the scatter target)."""
+    def h(l):
+        a = np.asarray(l)
+        return a.copy() if copy or not a.flags.writeable else a
+    return jax.tree.map(h, pool)
+
+
+def _pool_data_rows(sim) -> int:
+    """Leading axis P of the pool's per-node data: node ``i`` reads row
+    ``i % P``, so a pool of nominal N can ride a data bank of P << N
+    shards (at 10M users nobody stacks 10M distinct shards)."""
+    return int(sim.data["xtr"].shape[0])
+
+
+# -- cohort sampling ---------------------------------------------------------
+
+def _seed_material(key: jax.Array) -> list[int]:
+    """Deterministic host seed material from a jax PRNG key (typed or
+    raw uint32)."""
+    try:
+        if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+            key = jax.random.key_data(key)
+    except Exception:
+        pass
+    return [int(x) for x in np.asarray(key).ravel().astype(np.uint32)]
+
+
+def sample_cohort(key: jax.Array, round0: int, n: int, c: int) -> np.ndarray:
+    """The round-``round0`` cohort: C distinct node ids, deterministic in
+    ``(key, round0)`` — a restored pool re-draws the identical schedule.
+
+    At C << N the draw rejection-samples uniques (no O(N) permutation —
+    the 10M path); small ratios fall back to numpy's exact choice.
+    Sorted ascending for gather locality.
+    """
+    ss = np.random.SeedSequence(_seed_material(key) + [int(round0)])
+    rng = np.random.default_rng(ss)
+    if c >= n:
+        return np.arange(n, dtype=np.int64)
+    if c * 8 >= n:
+        return np.sort(rng.choice(n, c, replace=False).astype(np.int64))
+    out = np.unique(rng.integers(0, n, int(c * 1.1) + 16))
+    while out.size < c:
+        out = np.unique(np.concatenate(
+            [out, rng.integers(0, n, c)]))
+    rng.shuffle(out)  # drop the unique-sort's small-id bias before cutting
+    return np.sort(out[:c])
+
+
+def _local_neighbor_table(sim, idx: np.ndarray) -> np.ndarray:
+    """[C, max_deg] cohort-LOCAL neighbor slots for ``peer_mode='induced'``:
+    gather the global table's cohort rows, keep entries that are
+    themselves in the cohort (membership via an inverse-index table),
+    everything else -1."""
+    n = sim.nominal_n
+    nbr = sim._cohort_nbr_global[idx]  # [C, max_deg] global ids / -1
+    pos = np.full(n, -1, dtype=np.int32)
+    pos[idx] = np.arange(idx.size, dtype=np.int32)
+    local = np.where(nbr >= 0, pos[np.clip(nbr, 0, n - 1)], -1)
+    return local.astype(np.int32)
+
+
+# -- the round-segment program ----------------------------------------------
+
+def _active_state(sim, model, phase, round0: int, aux):
+    """A [C]-shaped SimState for one cohort segment: gathered durable
+    state + freshly-built round-scoped state (empty mailboxes, history
+    ring re-broadcast from the gathered params — cohort rotation has no
+    in-flight traffic to preserve, so the broadcast IS the ring a
+    same-round send would read)."""
+    from .engine import Mailbox, SimState
+    c = sim.n_nodes
+    d = sim._history_depth(sim._model_size(model.params))
+    stored, scales = sim._encode_history_rows(model.params)
+    bcast = lambda l: jnp.broadcast_to(l[None], (d,) + l.shape)
+    hist_p = jax.tree.map(bcast, stored)
+    hist_s = (jax.tree.map(bcast, scales)
+              if sim.history_dtype == "int8" else ())
+    hist_a = jnp.broadcast_to(model.n_updates[None],
+                              (d,) + model.n_updates.shape)
+    return SimState(
+        model=model, phase=phase,
+        history_params=hist_p, history_ages=hist_a,
+        mailbox=Mailbox.empty(d, c, sim.K),
+        reply_box=Mailbox.empty(d, c, sim.Kr),
+        round=jnp.int32(round0), aux=aux, history_scale=hist_s)
+
+
+def _make_cohort_run(sim, n_rounds: int):
+    """The segment program: ``(state, key, data, last_round[, hc]) ->
+    (state[, hc], stats)``. The ``_make_run`` scan with the RUN's final
+    absolute round as a traced argument — segments share one compiled
+    program even though only the last one force-evaluates."""
+    sentinels_on = sim.sentinels is not None
+
+    def scan_rounds(state, key, last_round, hc):
+        def body(carry, _):
+            if sentinels_on:
+                st, c = carry
+                pre_params = st.model.params
+            else:
+                st, c = carry, None
+            st, stats = sim._round(st, key, last_round)
+            if sentinels_on:
+                c, hstats = sim._health_round(c, pre_params, st, stats)
+                stats.update(hstats)
+            return ((st, c) if sentinels_on else st), stats
+
+        init = (state, hc) if sentinels_on else state
+        return jax.lax.scan(body, init, None, length=n_rounds)
+
+    if sentinels_on:
+        def run(state, key, data, last_round, hc):
+            saved = sim.data
+            sim.data = data
+            try:
+                (state, hc), stats = scan_rounds(state, key, last_round, hc)
+                return state, hc, stats
+            finally:
+                sim.data = saved
+    else:
+        def run(state, key, data, last_round):
+            saved = sim.data
+            sim.data = data
+            try:
+                return scan_rounds(state, key, last_round, None)
+            finally:
+                sim.data = saved
+    return run
+
+
+def _segment_fn(sim, seg_rounds: int):
+    """Compile-cached segment program (one per distinct segment length —
+    the tail segment of a run whose n_rounds is not a multiple of
+    rounds_per_cohort costs one extra compile, like CheckpointManager
+    tail chunks)."""
+    cache_k = ("cohort", seg_rounds, sim._cache_salt())
+    if cache_k not in sim._jit_cache:
+        fn = jax.jit(_make_cohort_run(sim, seg_rounds), donate_argnums=(0,))
+        sim._jit_cache[cache_k] = fn
+    return sim._jit_cache[cache_k], cache_k
+
+
+# -- the driver --------------------------------------------------------------
+
+def cohort_start(sim, pool: CohortPool, n_rounds: int,
+                 key: Optional[jax.Array] = None):
+    """Run ``n_rounds`` active-cohort rounds against the resident pool.
+
+    Host-driven segment loop (the actor/learner split): per segment,
+    sample the cohort (deterministic in ``(key, absolute round)``),
+    gather pool rows + data rows, run the jitted ``[C]`` round program,
+    scatter the durable state back and advance the pool round counter.
+    Returns ``(pool, SimulationReport)`` — the report carries the
+    standard per-round arrays at cohort width plus the
+    ``cohort_coverage`` / ``cohort_active_nodes`` accounting rows.
+    """
+    if not isinstance(pool, CohortPool):
+        raise TypeError(
+            "cohort mode takes the resident CohortPool (init_cohort_pool), "
+            f"got {type(pool).__name__}")
+    if key is None:
+        key = jax.random.PRNGKey(42)
+    cfg: CohortConfig = sim.cohort
+    c, n = cfg.size, sim.nominal_n
+    p_rows = _pool_data_rows(sim)
+    first_round = int(np.asarray(pool.round))
+    last_round = first_round + n_rounds - 1
+
+    if sim.has_live_receivers():
+        import warnings
+        warnings.warn("cohort mode has no in-run host callback path; live "
+                      "event receivers fall back to post-run replay")
+
+    pool = _host_pool(pool, copy=True)
+    model_leaves = jax.tree.leaves(pool.model)
+    touched = pool.touched
+    seg_stats: list[dict] = []
+    coverage: list[float] = []
+    perf_on = sim.perf is not None and sim.perf.timing
+    t_run0 = time.perf_counter()
+    any_cold = False
+
+    done = 0
+    while done < n_rounds:
+        seg = min(cfg.rounds_per_cohort, n_rounds - done)
+        r0 = first_round + done
+        fn, cache_k = _segment_fn(sim, seg)
+        cold = not getattr(fn, "_gossipy_warm", False)
+
+        idx = sample_cohort(key, r0, n, c)
+        jidx = jnp.asarray(idx)
+        sub_model = jax.tree.map(
+            lambda l: jnp.asarray(np.asarray(l)[idx]), pool.model)
+        phase_c = jnp.asarray(np.asarray(pool.phase)[idx])
+        aux = ()
+        if cfg.peer_mode == "induced":
+            aux = {"cohort_nbr": jnp.asarray(
+                _local_neighbor_table(sim, idx))}
+        data_c = {k: (v if k in ("x_eval", "y_eval")
+                      else v[jidx % p_rows])
+                  for k, v in sim.data.items()}
+        state = _active_state(sim, sub_model, phase_c, r0, aux)
+
+        args = (state, key, data_c, jnp.int32(last_round))
+        if sim.sentinels is not None:
+            hc = (sim._health_carry if sim._health_carry is not None
+                  else sim._health_zero_carry())
+            args = args + (hc,)
+        if cold:
+            any_cold = True
+            t_c0 = time.perf_counter()
+            if sim.perf is not None and sim.perf.cost:
+                # The start() AOT detour: bank the segment program's own
+                # cost/memory analysis at compile time.
+                try:
+                    compiled = fn.lower(*args).compile()
+                except Exception:
+                    pass
+                else:
+                    sim._record_cost(
+                        compiled, label=f"cohort_start[{seg}r/C{c}]",
+                        n_rounds=seg)
+                    sim._jit_cache[cache_k] = compiled
+                    fn = compiled
+            try:
+                fn._gossipy_warm = True  # jit wrappers take attributes
+            except Exception:
+                pass
+        out = fn(*args)
+        if sim.sentinels is not None:
+            final_state, sim._health_carry, stats = out
+        else:
+            final_state, stats = out
+        if cold and sim.last_compile_seconds is None:
+            sim.last_compile_seconds = time.perf_counter() - t_c0
+
+        # Scatter the durable state back into the pool (host).
+        for dst, src in zip(model_leaves,
+                            jax.tree.leaves(final_state.model)):
+            dst[idx] = np.asarray(src)
+        pool.phase[idx] = np.asarray(final_state.phase)
+        touched[idx] = True
+        cov = float(touched.mean())
+        coverage.extend([cov] * seg)
+        seg_stats.append(jax.tree.map(np.asarray, stats))
+        done += seg
+
+    stats_all: dict = {}
+    for k in seg_stats[0]:
+        stats_all[k] = np.concatenate([s[k] for s in seg_stats], axis=0)
+    stats_all["cohort_coverage"] = np.asarray(coverage, np.float32)
+    stats_all["cohort_active_nodes"] = np.full((n_rounds,), c, np.int32)
+
+    if perf_on:
+        exec_seconds = time.perf_counter() - t_run0
+        stats_all = sim._attach_perf_stats(stats_all, n_rounds,
+                                           exec_seconds, any_cold)
+    report = sim._build_report(stats_all)
+    if sim.metrics_enabled:
+        stats_all = sim._feed_metrics(dict(stats_all), report, n_rounds)
+    sim.replay_events(first_round, stats_all, sim._metric_keys(),
+                      include_live=True)
+
+    new_pool = CohortPool(model=pool.model, phase=pool.phase,
+                          node_key=pool.node_key, touched=touched,
+                          round=np.asarray(first_round + n_rounds,
+                                           np.int32))
+    return new_pool, report
+
+
+def pool_bytes(sim) -> int:
+    """Pool-residency bytes: the durable per-node state x nominal N (the
+    ``memory_budget`` cohort block and the ladder's pool column)."""
+    st = _model_shape(sim)
+    per_node = sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                   for l in jax.tree.leaves(st))
+    per_node += 4            # phase (int32)
+    per_node += 8            # node_key (2 x uint32)
+    per_node += 1            # touched (bool)
+    return per_node * sim.nominal_n

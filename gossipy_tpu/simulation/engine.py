@@ -429,6 +429,21 @@ class GossipSimulator(SimulationEventSender):
         links at SEND time; in-flight messages still drain. Variants
         overriding ``_select_peers`` (PENS) cannot take edge faults and
         raise at construction.
+    cohort : CohortConfig | int | dict | None
+        Opt-in sampled active-cohort mode (:mod:`.cohort`): ``topology``
+        names the NOMINAL population of size N (or a
+        :class:`~gossipy_tpu.simulation.cohort.NominalTopology` size
+        stand-in), the full population lives as a host-resident
+        :class:`~gossipy_tpu.simulation.cohort.CohortPool`
+        (:meth:`init_cohort_pool`), and each round materializes only a
+        sampled cohort of C nodes — gather, run the standard jitted
+        round at shape [C, ...], scatter back — so per-round cost
+        decouples from N and nominal 10M populations are simulable at
+        the cost of C. ``None`` (default) traces the exact same program
+        as before the feature (gate-enforced identity pair). Mutually
+        exclusive with ``chaos``; base GossipSimulator only. See
+        docs/scale.md for semantics + the bias caveats vs
+        full-population gossip.
     """
 
     # Out-of-tree subclasses that override ``_decode_extra`` or
@@ -474,13 +489,36 @@ class GossipSimulator(SimulationEventSender):
                  sentinels: Union[None, bool, SentinelConfig] = None,
                  chaos: Union[None, dict, ChaosConfig] = None,
                  perf: Union[None, bool, PerfConfig] = None,
-                 metrics: Union[None, bool] = None):
+                 metrics: Union[None, bool] = None,
+                 cohort=None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
                 f"unknown history_dtype {history_dtype!r}; options: "
                 + ", ".join(self._HISTORY_DTYPES))
         self.history_dtype = history_dtype
+        # Sampled active-cohort mode (simulation.cohort): None = strictly
+        # no cohort code anywhere near the trace (the default round
+        # program is byte-identical to the pre-feature one — the
+        # engine/cohort-off identity pair in analysis/hlo.py enforces
+        # it). When set, ``topology`` names the NOMINAL population (a
+        # real graph, or a NominalTopology size stand-in for resample
+        # mode) and is swapped here for the C-node inner round topology
+        # the rest of construction sizes against; the full population
+        # lives in a host-resident CohortPool (init_cohort_pool) and
+        # start() drives gather -> [C]-round -> scatter segments.
+        self.nominal_topology = None
+        self.nominal_n = int(topology.num_nodes)
+        from .cohort import CohortConfig
+        self.cohort = CohortConfig.coerce(cohort)
+        if self.cohort is not None:
+            if chaos is not None:
+                raise ValueError(
+                    "cohort mode and chaos scheduling are mutually "
+                    "exclusive (fault schedules are nominal-population-"
+                    "indexed; the active cohort rotates)")
+            from .cohort import setup_cohort
+            topology = setup_cohort(self, topology)
         self.handler = handler
         self.topology = topology
         self.n_nodes = topology.num_nodes
@@ -705,6 +743,12 @@ class GossipSimulator(SimulationEventSender):
         same again for PUSH_PULL). Max drives the mailbox bound; the full
         vector drives the compaction capacity — on hub topologies the max
         (the hub) says nothing about how many NODES see multi-arrivals."""
+        if self.cohort is not None:
+            # The inner cohort round samples peers uniformly over the
+            # active cohort (or its induced subgraph, whose fan-in is
+            # bounded by the same draw): expected fan-in is exactly F
+            # per node, with no O(N) nominal-topology scan.
+            return np.full(self.n_nodes, float(self.F))
         if self.n_nodes == 0:
             return np.zeros(0)
         deg = np.maximum(np.asarray(self.topology.degrees, dtype=np.float64), 1.0)
@@ -936,6 +980,28 @@ class GossipSimulator(SimulationEventSender):
         }
         out["total_bytes"] = sum(v for k, v in out.items()
                                  if k.endswith("_bytes") and v is not None)
+        if self.cohort is not None:
+            # Cohort-aware accounting: the keys above price the ACTIVE
+            # [C]-shaped round (n == C here); the pool prices the nominal
+            # population's durable state, host-resident — deliberately
+            # named without the ``_bytes`` suffix so the device total
+            # stays the active-round budget. ``materialized_prediction``
+            # is what the N-scaled active terms would cost fully
+            # materialized (the ladder's pool-vs-materialized column).
+            from .cohort import pool_bytes
+            n_scaled = sum(
+                out[k] or 0 for k in
+                ("model_and_opt_bytes", "history_ring_bytes",
+                 "history_ages_bytes", "aux_bytes", "mailbox_bytes",
+                 "reply_box_bytes") if out.get(k) is not None)
+            out["cohort_size"] = self.n_nodes
+            out["nominal_n"] = self.nominal_n
+            out["cohort_pool_resident"] = pool_bytes(self)
+            out["cohort_active_total"] = out["total_bytes"]
+            out["cohort_materialized_prediction"] = (
+                int(n_scaled * (self.nominal_n / max(self.n_nodes, 1)))
+                + (out.get("data_bytes") or 0)
+                + (out.get("eval_peak_bytes") or 0))
         return out
 
     def _local_data(self):
@@ -1051,6 +1117,11 @@ class GossipSimulator(SimulationEventSender):
         training never recovers — a 100-node CIFAR run stays at chance
         without it. The local pre-training pass still diversifies nodes.
         """
+        if self.cohort is not None:
+            raise ValueError(
+                "cohort mode keeps the population in a resident pool — "
+                "use init_cohort_pool() and start(pool, ...) instead of "
+                "init_nodes()")
         n = self.n_nodes
         self._health_carry = None  # fresh population, fresh sentinel EMA
         k_init, k_phase, k_up = jax.random.split(key, 3)
@@ -1088,6 +1159,21 @@ class GossipSimulator(SimulationEventSender):
             aux=self._init_aux(model, key),
             history_scale=hist_s,
         )
+
+    def init_cohort_pool(self, key: jax.Array, common_init: bool = False,
+                         local_train: bool = False,
+                         block: Optional[int] = None):
+        """Cohort-mode population init: the resident
+        :class:`~gossipy_tpu.simulation.cohort.CohortPool` of nominal
+        size N (host numpy, built in device blocks — see
+        :func:`gossipy_tpu.simulation.cohort.init_cohort_pool` for the
+        ``local_train`` default's bias note)."""
+        if self.cohort is None:
+            raise ValueError("init_cohort_pool requires cohort=; use "
+                             "init_nodes() for materialized populations")
+        from .cohort import init_cohort_pool
+        return init_cohort_pool(self, key, common_init=common_init,
+                                local_train=local_train, block=block)
 
     def _init_aux(self, model: ModelState, key: jax.Array):
         """Variant-specific per-node state (token balances, caches, ...)."""
@@ -1156,8 +1242,14 @@ class GossipSimulator(SimulationEventSender):
     def _select_peers(self, state: SimState, base_key, r) -> jax.Array:
         """One peer per node (overridden e.g. by PENS peer selection).
         With chaos partitions/churn scheduled, the draw runs over the
-        round's alive-edge mask instead of the frozen adjacency."""
+        round's alive-edge mask instead of the frozen adjacency. In
+        cohort mode with ``peer_mode="induced"`` the draw runs over the
+        cohort-local neighbor table riding ``state.aux`` (the induced
+        subgraph is per-cohort DATA, not a trace constant)."""
         key = self._round_key(base_key, r, _K_PEER)
+        if self.cohort is not None and self.cohort.peer_mode == "induced":
+            from .cohort import induced_peers
+            return induced_peers(self, state, key)
         if self.chaos is not None and self._chaos_edge_form is not None:
             return self._chaos_masked_peers(key, r)
         return self.topology.sample_peers(key)
@@ -2177,8 +2269,16 @@ class GossipSimulator(SimulationEventSender):
         pickled object graph), so call this on a simulator built with the
         same configuration. Pass ``mesh`` to restore a checkpoint from a
         sharded run directly INTO the mesh's node-axis shardings (restores
-        go to the template's placement, not the file-recorded one)."""
+        go to the template's placement, not the file-recorded one).
+
+        In cohort mode the checkpoint unit is the resident
+        :class:`~gossipy_tpu.simulation.cohort.CohortPool` (host numpy;
+        ``mesh`` does not apply) and the template is a cheap zero-filled
+        pool — restores stay O(pool bytes), never O(init compute)."""
         from ..checkpoint import restore_checkpoint
+        if self.cohort is not None:
+            from .cohort import pool_template
+            return restore_checkpoint(path, pool_template(self), key)
         template = self.init_nodes(jax.random.PRNGKey(0), local_train=False)
         if mesh is not None:
             from ..parallel import shard_state
@@ -2254,6 +2354,10 @@ class GossipSimulator(SimulationEventSender):
         reference has no analogue (its rounds are Python loops; SURVEY §5
         tracing/profiling).
         """
+        if self.cohort is not None:
+            raise ValueError("cohort mode is segment-driven; lower the "
+                             "inner round program via a cohort=None twin "
+                             "at n_nodes=C instead")
         if key is None:
             key = jax.random.PRNGKey(42)
         args = (state, key, self.data)
@@ -2279,7 +2383,16 @@ class GossipSimulator(SimulationEventSender):
         The donated input is INVALIDATED; pass ``donate_state=False`` when
         you reuse the same initial state for several runs (A/B comparisons,
         warmup-then-measure).
+
+        In cohort mode ``state`` is the resident :class:`~gossipy_tpu.
+        simulation.cohort.CohortPool` and the call is the host-driven
+        gather -> [C]-round -> scatter segment loop (``profile_dir`` /
+        ``donate_state`` do not apply there: segments donate their own
+        freshly-built state).
         """
+        if self.cohort is not None:
+            from .cohort import cohort_start
+            return cohort_start(self, state, n_rounds, key)
         if key is None:
             key = jax.random.PRNGKey(42)
 
@@ -2394,6 +2507,8 @@ class GossipSimulator(SimulationEventSender):
         extras.update({k: opt(k) for k in HEALTH_STAT_KEYS if k in stats})
         extras.update({k: opt(k) for k in CHAOS_PROBE_KEYS if k in stats})
         extras.update({k: opt(k) for k in PERF_STAT_KEYS if k in stats})
+        from .cohort import COHORT_STAT_KEYS
+        extras.update({k: opt(k) for k in COHORT_STAT_KEYS if k in stats})
         if self.probes is not None:
             if self.probes.consensus:
                 extras["probe_layer_names"] = self._probe_layer_names()
@@ -2466,6 +2581,10 @@ class GossipSimulator(SimulationEventSender):
         """
         assert not self._receivers_list(), \
             "run_repetitions does not support event receivers; use start()"
+        if self.cohort is not None:
+            raise ValueError("cohort mode is host-driven per segment and "
+                             "cannot ride the seed vmap; run start() per "
+                             "seed against separate pools")
 
         cache_k = ("reps", n_rounds, bool(local_train), bool(common_init),
                    self._cache_salt())

@@ -112,6 +112,14 @@ class SimulationEventReceiver:
         Host-derived after the segment finishes (like ``update_perf``),
         so replay-only. Fired after ``update_perf``."""
 
+    def update_cohort(self, round: int, cohort: dict) -> None:
+        """Per-round active-cohort accounting (fired only by ``cohort=``
+        runs; see :mod:`gossipy_tpu.simulation.cohort`). ``cohort``
+        carries ``coverage`` (fraction of the nominal pool any cohort
+        has touched so far) and ``active_nodes`` (the materialized
+        cohort width C). Host-driven segment loop — replay-only, like
+        ``update_perf``. Fired after ``update_metrics``."""
+
     def update_evaluation(self, round: int, on_user: bool,
                           metrics: dict[str, float]) -> None:
         """Mean metrics for this round (``on_user`` = local test sets)."""
@@ -154,7 +162,8 @@ class SimulationEventSender:
                       health: Optional[dict] = None,
                       chaos: Optional[dict] = None,
                       perf: Optional[dict] = None,
-                      metrics: Optional[dict] = None) -> None:
+                      metrics: Optional[dict] = None,
+                      cohort: Optional[dict] = None) -> None:
         for r in self._receivers_list():
             if live_only and not r.live:
                 continue
@@ -173,6 +182,8 @@ class SimulationEventSender:
                 r.update_perf(round, perf)
             if metrics is not None:
                 r.update_metrics(round, metrics)
+            if cohort is not None:
+                r.update_cohort(round, cohort)
             if local is not None:
                 r.update_evaluation(round, True, local)
             if glob is not None:
@@ -223,6 +234,8 @@ class SimulationEventSender:
         # Host-assembled list of per-round dicts (engine metrics= feed);
         # unlike the array stats above it never transits the device.
         metrics_rows = stats.get("metrics_rows")
+        cohort_cov = stats.get("cohort_coverage")
+        cohort_active = stats.get("cohort_active_nodes")
 
         def row(arr, i):
             vals = arr[i]
@@ -241,12 +254,18 @@ class SimulationEventSender:
             metrics = (metrics_rows[i]
                        if metrics_rows is not None and i < len(metrics_rows)
                        else None)
+            cohort = None
+            if cohort_cov is not None:
+                cohort = {"coverage": float(cohort_cov[i]),
+                          "active_nodes": (int(cohort_active[i])
+                                           if cohort_active is not None
+                                           else None)}
             self._notify_round(first_round + i + 1, int(sent[i]),
                                int(failed[i]), int(size[i]),
                                row(local, i), row(glob, i),
                                include_live=include_live, causes=causes,
                                probes=probes, health=health, chaos=chaos,
-                               perf=perf, metrics=metrics)
+                               perf=perf, metrics=metrics, cohort=cohort)
         if fire_end:
             self._notify_end()
 
@@ -347,6 +366,9 @@ class CallbackReceiver(SimulationEventReceiver):
     def update_metrics(self, round, metrics):
         self._row["metrics"] = dict(metrics)
 
+    def update_cohort(self, round, cohort):
+        self._row["cohort"] = dict(cohort)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = dict(metrics)
 
@@ -412,6 +434,12 @@ class JSONLinesReceiver(SimulationEventReceiver):
                                     stream writes null here because the
                                     timing is host-derived after the
                                     segment)
+        v8      ``cohort``          active-cohort accounting row
+                                    ``| null``: ``coverage`` (fraction
+                                    of the nominal pool any cohort has
+                                    touched) and ``active_nodes`` (the
+                                    materialized cohort width C) — null
+                                    without ``cohort=``
         v7      ``metrics``         cumulative engine-counter row
                                     ``| null``: ``rounds_total``,
                                     ``sent_total``, ``failed_total`` —
@@ -435,7 +463,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
     :meth:`close` when done.
     """
 
-    SCHEMA = 7
+    SCHEMA = 8
 
     def __init__(self, path: str, live: bool = False):
         import json
@@ -450,7 +478,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
                      "failed": failed, "failed_by_cause": None,
                      "size": size, "probes": None, "health": None,
                      "chaos": None, "perf": None, "metrics": None,
-                     "local": None, "global": None}
+                     "cohort": None, "local": None, "global": None}
 
     def update_failure_causes(self, round, causes):
         self._row["failed_by_cause"] = dict(causes)
@@ -470,6 +498,9 @@ class JSONLinesReceiver(SimulationEventReceiver):
     def update_metrics(self, round, metrics):
         self._row["metrics"] = dict(metrics)
 
+    def update_cohort(self, round, cohort):
+        self._row["cohort"] = dict(cohort)
+
     def update_evaluation(self, round, on_user, metrics):
         self._row["local" if on_user else "global"] = metrics
 
@@ -481,7 +512,7 @@ class JSONLinesReceiver(SimulationEventReceiver):
 
     @classmethod
     def parse_line(cls, line: str) -> dict:
-        """Version-tolerant row reader: normalize a v1..v7 line into
+        """Version-tolerant row reader: normalize a v1..v8 line into
         the CURRENT schema's shape (fields a line's version predates come
         back null, unknown future fields pass through untouched). The one
         reader consumers should use instead of re-encoding the version
@@ -501,6 +532,8 @@ class JSONLinesReceiver(SimulationEventReceiver):
             row.setdefault("perf", None)
         if schema < 7:
             row.setdefault("metrics", None)
+        if schema < 8:
+            row.setdefault("cohort", None)
         return row
 
     def close(self):

@@ -48,8 +48,10 @@ import numpy as np
 # 5: + scheduled-fault chaos arrays (chaos_*; simulation.faults) and the
 #    optional "chaos" key in failed_per_cause;
 # 6: + performance arrays (perf_*; telemetry.cost) — host-measured
-#    ms/round and the per-round MFU estimate.
-REPORT_SCHEMA = 6
+#    ms/round and the per-round MFU estimate;
+# 7: + active-cohort accounting arrays (cohort_*; simulation.cohort) —
+#    pool coverage fraction and the materialized cohort width per round.
+REPORT_SCHEMA = 7
 
 # Optional per-round arrays (attribute name == JSON key), concatenated
 # along axis 0 by :meth:`SimulationReport.concatenate` (surviving only
@@ -92,6 +94,11 @@ PER_ROUND_FIELDS = (
                                      # segment; perf= runs only)
     "perf_mfu_est",                  # [R] f32: flops/round vs the chip
                                      # peak (NaN off known accelerators)
+    "cohort_coverage",               # [R] f32: fraction of the nominal
+                                     # pool touched by any cohort so far
+                                     # (cohort runs only)
+    "cohort_active_nodes",           # [R] i32: materialized cohort width
+                                     # C (cohort runs only)
     "wall_clock_seconds_per_round",  # [R] f64 (live runs only)
 )
 
@@ -112,7 +119,7 @@ _INT_FIELDS = frozenset({
     "health_nonfinite_metrics", "health_first_bad_slot",
     "health_mix_nonfinite", "health_diverged_per_node",
     "health_mailbox_hwm_run", "health_trip",
-    "chaos_active_components",
+    "chaos_active_components", "cohort_active_nodes",
 })
 
 

@@ -129,6 +129,28 @@ def _config_snapshot(sim: Any) -> dict:
         # in the manifest's top-level ``perf`` block, not here).
         perf = sim.perf
         snap["perf"] = perf.to_dict() if perf is not None else None
+    if hasattr(sim, "cohort"):
+        # The active CohortConfig (simulation.cohort) or None; cohort
+        # runs also record the nominal population (config "n_nodes" is
+        # the materialized cohort width C there) and the nominal
+        # topology class the inner clique-like round world replaced.
+        cohort = sim.cohort
+        snap["cohort"] = cohort.to_dict() if cohort is not None else None
+        if cohort is not None:
+            snap["nominal_n"] = getattr(sim, "nominal_n", None)
+            nom = getattr(sim, "nominal_topology", None)
+            if nom is not None:
+                snap["topology"] = type(nom).__name__
+    if hasattr(sim, "topology"):
+        # The resolved partition-rule table (parallel/rules.py): which
+        # placement registry produced this run's shardings — every spec
+        # in parallel/ derives from it, so stamping the table makes a
+        # sharded run's placement auditable from the manifest alone.
+        try:
+            from ..parallel.rules import STATE_RULES, rules_table
+            snap["partition_rules"] = rules_table(STATE_RULES)
+        except Exception:
+            snap["partition_rules"] = None
     if hasattr(sim, "metrics_enabled"):
         # Whether this run fed the host-side SLO metrics registry
         # (telemetry.metrics) — the counters themselves live in the

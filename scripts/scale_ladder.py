@@ -55,6 +55,12 @@ if _REPO not in sys.path:
 
 DEFAULT_RUNGS = (1_000, 2_000, 5_000, 10_000, 20_000, 50_000, 100_000)
 SMOKE_RUNGS = (16, 24, 32, 48)
+# --cohort rungs: NOMINAL populations at a fixed materialized cohort C —
+# the rungs the materialized ladder cannot climb at all (simulation.cohort
+# decouples per-round cost from N; the interesting measure per rung is
+# pool-residency bytes vs the materialized prediction).
+COHORT_RUNGS = (1_000_000, 10_000_000)
+SMOKE_COHORT_RUNGS = (20_000, 50_000)
 
 
 def build_rung_sim(n_nodes: int, degree: int, rounds: int,
@@ -97,6 +103,48 @@ def build_rung_sim(n_nodes: int, degree: int, rounds: int,
                            sentinels=True, perf=True)
 
 
+def build_cohort_rung_sim(nominal_n: int, cohort_size: int, rounds: int,
+                          history_dtype: str = "float32"):
+    """A --cohort rung's simulator: the same LogReg round shape at a
+    fixed materialized cohort C over a NOMINAL population of nominal_n
+    (NominalTopology — resample-mode cohorts never read edges, so no
+    O(N) graph is built). The data bank is 4C shards; node i reads shard
+    i % P (the cohort scaling story, not a shortcut)."""
+    import optax
+
+    from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import CohortConfig, GossipSimulator, \
+        NominalTopology
+
+    d = 57
+    cohort_size = min(cohort_size, nominal_n)
+    pool_shards = min(nominal_n, 4 * cohort_size)
+    rng = np.random.default_rng(42)
+    w = rng.normal(size=d)
+    X = rng.normal(size=(4 * pool_shards, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    eval_cap = min(2048, max(1, int(0.2 * len(X))))
+    disp = DataDispatcher(
+        ClassificationDataHandler(X, y, test_size=eval_cap / len(X)),
+        n=pool_shards, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(d, 2),
+                         loss=losses.cross_entropy,
+                         optimizer=optax.sgd(0.1),
+                         local_epochs=1, batch_size=4, n_classes=2,
+                         input_shape=(d,),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    return GossipSimulator(handler, NominalTopology(nominal_n),
+                           disp.stacked(), delta=100,
+                           protocol=AntiEntropyProtocol.PUSH,
+                           sampling_eval=0.01, eval_every=rounds,
+                           history_dtype=history_dtype,
+                           cohort=CohortConfig(size=cohort_size),
+                           sentinels=True, perf=True)
+
+
 def _stamp(msg: str) -> None:
     # The bench.py --scale discipline: phase-stamped progress so a dead
     # run's last words name where it died even without a traceback.
@@ -120,10 +168,13 @@ def _inject_fault(sim, n_nodes: int) -> None:
 
 def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
              history_dtype: str, fail: bool,
-             prev: dict | None) -> dict:
+             prev: dict | None, cohort_size: int | None = None) -> dict:
     """Run one rung; returns its ladder row. Raises on rung failure with
     ``row_so_far`` / ``bundle`` attached to the exception (the driver
-    turns that into the verdict)."""
+    turns that into the verdict). With ``cohort_size`` the rung runs in
+    active-cohort mode: ``n_nodes`` is the NOMINAL population, the row
+    gains ``nominal_n`` + pool-residency-vs-materialized accounting, and
+    the measured columns price the [C]-wide segment loop."""
     import jax
 
     from gossipy_tpu.telemetry import FlightRecorder
@@ -132,7 +183,13 @@ def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
                  "history_dtype": history_dtype}
     _stamp(f"rung {n_nodes}: building topology+simulator")
     t0 = time.perf_counter()
-    sim = build_rung_sim(n_nodes, degree, rounds, history_dtype)
+    if cohort_size:
+        row["nominal_n"] = n_nodes
+        row["cohort_size"] = min(cohort_size, n_nodes)
+        sim = build_cohort_rung_sim(n_nodes, cohort_size, rounds,
+                                    history_dtype)
+    else:
+        sim = build_rung_sim(n_nodes, degree, rounds, history_dtype)
     row["build_seconds"] = round(time.perf_counter() - t0, 2)
 
     budget = sim.memory_budget()
@@ -146,16 +203,24 @@ def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
         "total_bytes": budget.get("total_bytes"),
         "history_ring_bytes": budget.get("history_ring_bytes"),
         "eval_peak_bytes": budget.get("eval_peak_bytes"),
+        # Cohort rungs: the pool-residency-vs-materialized pair (None on
+        # materialized rungs).
+        "pool_resident_bytes": budget.get("cohort_pool_resident"),
+        "materialized_prediction_bytes":
+            budget.get("cohort_materialized_prediction"),
         "flops_per_round": (analytic or {}).get("flops_per_round"),
         "flops_per_round_executed":
             (analytic or {}).get("flops_per_round_executed"),
         # Linear-in-N extrapolation from the previous measured rung: the
         # sparse round program's dominant terms all scale with N, so a
-        # super-linear measured/predicted ratio is itself a finding.
+        # super-linear measured/predicted ratio is itself a finding. A
+        # cohort rung's round is [C]-wide at fixed C — the prediction is
+        # FLAT in nominal N, and a measured slope is itself a finding
+        # (it would mean the pool gathers, not the round, dominate).
         "ms_per_round": (
             None if prev is None or not prev.get("measured")
             else prev["measured"]["ms_per_round"]
-            * n_nodes / prev["n_nodes"]),
+            * (1.0 if cohort_size else n_nodes / prev["n_nodes"])),
     }
     _stamp(f"rung {n_nodes}: predicted "
            f"{(budget.get('total_bytes') or 0) / 2**20:.1f} MB, "
@@ -166,8 +231,12 @@ def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
     os.makedirs(rung_dir, exist_ok=True)
     rec = FlightRecorder(rung_dir, chunk=rounds)
     key = jax.random.PRNGKey(42)
-    _stamp(f"rung {n_nodes}: init_nodes")
-    state = sim.init_nodes(key)
+    if cohort_size:
+        _stamp(f"rung {n_nodes}: init_cohort_pool (C {row['cohort_size']})")
+        state = sim.init_cohort_pool(key)
+    else:
+        _stamp(f"rung {n_nodes}: init_nodes")
+        state = sim.init_nodes(key)
     if fail:
         _inject_fault(sim, n_nodes)
     _stamp(f"rung {n_nodes}: compile + {rounds}-round run "
@@ -246,19 +315,26 @@ def _verdict_for(exc: Exception, n_nodes: int,
 
 def _markdown(rows: list, verdict: dict | None) -> str:
     lines = [
-        "| N | predicted MB | hbm peak MB | ms/round | rounds/s | "
-        "MFU est | pred/meas time |",
-        "|---|---|---|---|---|---|---|",
+        "| N | nominal_n | predicted MB | pool MB | hbm peak MB | "
+        "ms/round | rounds/s | MFU est | pred/meas time |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
 
     def mb(v):
         return f"{v / 2**20:.1f}" if v else "—"
     for r in rows:
         m = r.get("measured") or {}
+        p = r.get("predicted") or {}
         mfu = m.get("mfu_est")
+        # Materialized rungs: N IS the materialized width and nominal_n
+        # repeats it; cohort rungs materialize only C and carry the
+        # nominal population + pool residency here.
+        width = r.get("cohort_size") or r["n_nodes"]
         lines.append(
-            f"| {r['n_nodes']:,} "
-            f"| {mb((r.get('predicted') or {}).get('total_bytes'))} "
+            f"| {width:,} "
+            f"| {r.get('nominal_n', r['n_nodes']):,} "
+            f"| {mb(p.get('total_bytes'))} "
+            f"| {mb(p.get('pool_resident_bytes'))} "
             f"| {mb(m.get('hbm_peak_bytes'))} "
             f"| {m.get('ms_per_round') and round(m['ms_per_round'], 2)} "
             f"| {m.get('rounds_per_sec') or '—'} "
@@ -285,6 +361,14 @@ def main(argv=None) -> int:
     ap.add_argument("--degree", type=int, default=None,
                     help="regular-graph degree (default 20; 4 with "
                          "--smoke, whose rungs are too small for 20)")
+    ap.add_argument("--cohort", action="store_true",
+                    help="active-cohort rungs: nominal N in "
+                         f"{COHORT_RUNGS} at a fixed materialized C "
+                         "(--cohort-size); ladder.md gains the nominal_n "
+                         "and pool-residency columns")
+    ap.add_argument("--cohort-size", type=int, default=None,
+                    help="materialized cohort width C for --cohort "
+                         "(default 1024; 64 with --smoke)")
     ap.add_argument("--out", default="ladder-artifacts")
     ap.add_argument("--history-dtype", default="float32",
                     choices=("float32", "bfloat16", "int8"))
@@ -303,10 +387,15 @@ def main(argv=None) -> int:
         if any(r < 2 for r in rungs):
             print("[ladder] rungs must be >= 2", file=sys.stderr)
             return 2
+    elif args.cohort:
+        rungs = SMOKE_COHORT_RUNGS if args.smoke else COHORT_RUNGS
     else:
         rungs = SMOKE_RUNGS if args.smoke else DEFAULT_RUNGS
     rounds = args.rounds or (3 if args.smoke else 100)
     degree = args.degree or (4 if args.smoke else 20)
+    cohort_size = None
+    if args.cohort:
+        cohort_size = args.cohort_size or (64 if args.smoke else 1024)
     os.makedirs(args.out, exist_ok=True)
 
     # A wedged accelerator tunnel must degrade to CPU, not hang the
@@ -334,7 +423,8 @@ def main(argv=None) -> int:
         try:
             row = run_rung(n, degree, rounds, args.out,
                            args.history_dtype, fail=(args.fail_at == n),
-                           prev=rows[-1] if rows else None)
+                           prev=rows[-1] if rows else None,
+                           cohort_size=cohort_size)
         except Exception as e:
             verdict = _verdict_for(e, n, last_healthy)
             rows.append(getattr(e, "ladder_row", None)
@@ -346,8 +436,9 @@ def main(argv=None) -> int:
         rows.append(row)
         last_healthy = n
 
-    out = {"schema": 1,
+    out = {"schema": 2,  # v2: + nominal_n/cohort_size/pool columns
            "backend": jax.default_backend(),
+           "cohort_size": cohort_size,
            "device_kind": jax.devices()[0].device_kind,
            "rounds_per_rung": rounds,
            "rungs": rows,

@@ -9,7 +9,7 @@ Covers the ISSUE-9 acceptance criteria:
   quiet on the static-by-contract counterexamples;
 - the registry-completeness meta-test: an injected unregistered
   ``health_bogus`` per-round field is flagged, and a simulated JSONL
-  schema v8 bump without a ``parse_line`` branch trips the tolerance
+  schema v9 bump without a ``parse_line`` branch trips the tolerance
   rule;
 - suppression comments, the file pragma, and the baseline waive exactly
   what they claim;
@@ -230,20 +230,20 @@ class TestRegistryRules:
 
     def test_schema_bump_without_parse_line_branch_is_flagged(self):
         ev_path = REPO / "gossipy_tpu" / "simulation" / "events.py"
-        src = ev_path.read_text().replace("SCHEMA = 7", "SCHEMA = 8")
-        assert "SCHEMA = 8" in src
+        src = ev_path.read_text().replace("SCHEMA = 8", "SCHEMA = 9")
+        assert "SCHEMA = 9" in src
         fs = lint({"gossipy_tpu/simulation/events.py": src})
         assert rules_of(fs) == ["schema-tolerance"]
-        assert "if schema < 8" in fs[0].message
+        assert "if schema < 9" in fs[0].message
 
     def test_schema_bump_with_branch_passes(self):
         ev_path = REPO / "gossipy_tpu" / "simulation" / "events.py"
-        src = ev_path.read_text().replace("SCHEMA = 7", "SCHEMA = 8")
+        src = ev_path.read_text().replace("SCHEMA = 8", "SCHEMA = 9")
         src = src.replace(
-            "        if schema < 7:",
-            "        if schema < 8:\n"
+            "        if schema < 8:",
+            "        if schema < 9:\n"
             "            row.setdefault(\"future\", None)\n"
-            "        if schema < 7:")
+            "        if schema < 8:")
         fs = lint({"gossipy_tpu/simulation/events.py": src})
         assert [f for f in fs if f.rule == "schema-tolerance"] == []
 

@@ -528,7 +528,7 @@ class TestReportEventsAndConfig:
         assert all({"component_gap", "within_mean", "active_components",
                     "failed_chaos"} <= set(row) for _, row in rec.rows)
         rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
-        assert all(r["schema"] == 7 for r in rows)
+        assert all(r["schema"] == 8 for r in rows)
         assert all(r["chaos"] is not None for r in rows)
         assert all("chaos" in r["failed_by_cause"] for r in rows)
         # Pre-v5 lines normalize with a null chaos field.

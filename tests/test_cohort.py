@@ -1,0 +1,355 @@
+"""Active-cohort mode (simulation.cohort): semantics, scale, round-trips.
+
+The ISSUE-14 acceptance pair this file carries:
+
+- a CPU test running cohort mode at NOMINAL N >= 1M with C <= 4096
+  materialized, converging on the pure-averaging sanity check;
+- ``cohort=None`` traces byte-identical HLO (also enforced by the
+  ``engine/cohort-off`` pair in ``scripts/hlo_gate.py``'s grid).
+"""
+
+import json
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import (
+    AntiEntropyProtocol,
+    CreateModelMode,
+    SparseTopology,
+    Topology,
+)
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import LogisticRegression
+from gossipy_tpu.simulation import (
+    CohortConfig,
+    CohortPool,
+    GossipSimulator,
+    JSONLinesReceiver,
+    NominalTopology,
+)
+from gossipy_tpu.simulation.cohort import pool_bytes, sample_cohort
+
+D = 6
+
+
+def make_data(n_shards, seed=0, samples_per=8):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=D)
+    X = rng.normal(size=(n_shards * samples_per, D)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.25),
+                          n=n_shards, eval_on_user=False)
+    return disp.stacked()
+
+
+def make_handler(lr=0.1):
+    return SGDHandler(model=LogisticRegression(D, 2),
+                      loss=losses.cross_entropy, optimizer=optax.sgd(lr),
+                      local_epochs=1, batch_size=8, n_classes=2,
+                      input_shape=(D,),
+                      create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+
+def make_sim(nominal=64, cohort=16, lr=0.1, topo=None, data_shards=None,
+             **kw):
+    data = make_data(data_shards or min(nominal, 64))
+    topo = topo or Topology.random_regular(nominal, 6, seed=3)
+    return GossipSimulator(make_handler(lr), topo, data, delta=20,
+                           protocol=AntiEntropyProtocol.PUSH,
+                           cohort=(CohortConfig(size=cohort)
+                                   if cohort else None), **kw)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+class TestCohortConfig:
+    def test_coerce(self):
+        assert CohortConfig.coerce(None) is None
+        cfg = CohortConfig(size=8)
+        assert CohortConfig.coerce(cfg) is cfg
+        assert CohortConfig.coerce(8) == cfg
+        assert CohortConfig.coerce({"size": 8}) == cfg
+        with pytest.raises(ValueError):
+            CohortConfig.coerce(True)
+        with pytest.raises(ValueError):
+            CohortConfig(size=1)
+        with pytest.raises(ValueError):
+            CohortConfig(size=8, peer_mode="bogus")
+        with pytest.raises(ValueError):
+            CohortConfig.from_dict({"size": 8, "bogus": 1})
+
+    def test_dict_roundtrip(self):
+        cfg = CohortConfig(size=32, rounds_per_cohort=2,
+                           peer_mode="induced")
+        assert CohortConfig.from_dict(cfg.to_dict()) == cfg
+
+    def test_rejections(self, key):
+        with pytest.raises(ValueError, match="exceeds the nominal"):
+            make_sim(nominal=8, cohort=16)
+        with pytest.raises(ValueError, match="mutually"):
+            from gossipy_tpu.simulation import ChaosConfig, OutageEpisode
+            make_sim(nominal=64, cohort=16, chaos=ChaosConfig(
+                outages=(OutageEpisode(nodes=(0,), start=1, stop=2),),
+                horizon=3))
+        sim = make_sim()
+        with pytest.raises(ValueError, match="init_cohort_pool"):
+            sim.init_nodes(key)
+        with pytest.raises(ValueError, match="cohort"):
+            sim.run_repetitions(2, jax.random.split(key, 2))
+        plain = make_sim(cohort=None)
+        with pytest.raises(ValueError, match="init_nodes"):
+            plain.init_cohort_pool(key)
+
+    def test_nominal_topology_refuses_structure(self):
+        t = NominalTopology(100)
+        assert t.num_nodes == 100
+        with pytest.raises(AttributeError, match="population size"):
+            t.degrees
+        with pytest.raises(ValueError, match="real topology"):
+            GossipSimulator(
+                make_handler(), NominalTopology(64), make_data(64),
+                delta=20, cohort=CohortConfig(size=8,
+                                              peer_mode="induced"))
+
+
+class TestCohortOffIsAbsent:
+    def test_cohort_off_hlo_identical(self):
+        """cohort=None traces the byte-identical program (the gate's
+        engine/cohort-off identity pair; first divergent instruction
+        named on failure)."""
+        from gossipy_tpu.analysis import assert_identical_hlo
+        absent = GossipSimulator(
+            make_handler(), Topology.random_regular(64, 6, seed=3),
+            make_data(64), delta=20,
+            protocol=AntiEntropyProtocol.PUSH)
+        assert_identical_hlo(make_sim(cohort=None), absent,
+                             label="cohort=None")
+
+    def test_default_report_has_no_cohort_fields(self, key):
+        sim = make_sim(cohort=None)
+        st = sim.init_nodes(key)
+        _, rep = sim.start(st, n_rounds=3, key=key)
+        assert rep.cohort_coverage is None
+        assert rep.cohort_active_nodes is None
+        assert rep.to_dict()["cohort_coverage"] is None
+
+
+class TestResampleRounds:
+    def test_accounting_and_coverage(self, key):
+        sim = make_sim(nominal=64, cohort=16)
+        pool = sim.init_cohort_pool(key)
+        pool, rep = sim.start(pool, n_rounds=8, key=key)
+        assert (rep.sent_per_round == 16).all()
+        assert (rep.cohort_active_nodes == 16).all()
+        cov = rep.cohort_coverage
+        assert (np.diff(cov) >= -1e-9).all()
+        assert np.isclose(cov[-1], pool.touched.mean())
+        assert int(np.asarray(pool.round)) == 8
+
+    def test_cohort_schedule_deterministic(self, key):
+        a = sample_cohort(key, 5, 1000, 64)
+        b = sample_cohort(key, 5, 1000, 64)
+        np.testing.assert_array_equal(a, b)
+        c = sample_cohort(key, 6, 1000, 64)
+        assert not np.array_equal(a, c)
+        assert np.unique(a).size == 64
+        # Large-N rejection path: still C uniques, deterministic.
+        big = sample_cohort(key, 0, 10_000_000, 4096)
+        assert np.unique(big).size == 4096
+        np.testing.assert_array_equal(
+            big, sample_cohort(key, 0, 10_000_000, 4096))
+
+    def test_caller_pool_not_mutated(self, key):
+        sim = make_sim(nominal=64, cohort=16)
+        pool0 = sim.init_cohort_pool(key)
+        before = [np.array(l) for l in
+                  jax.tree_util.tree_leaves(pool0.model)]
+        sim.start(pool0, n_rounds=4, key=key)
+        for a, b in zip(before, jax.tree_util.tree_leaves(pool0.model)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_events_jsonl_v8_cohort_rows(self, key, tmp_path):
+        sim = make_sim(nominal=64, cohort=16)
+        path = str(tmp_path / "run.jsonl")
+        with JSONLinesReceiver(path) as rx:
+            sim.add_receiver(rx)
+            pool = sim.init_cohort_pool(key)
+            sim.start(pool, n_rounds=4, key=key)
+        rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
+        assert len(rows) == 4
+        for r in rows:
+            assert r["schema"] == 8
+            assert r["cohort"]["active_nodes"] == 16
+            assert 0 < r["cohort"]["coverage"] <= 1
+
+    def test_manifest_carries_cohort_and_rules(self, key):
+        sim = make_sim(nominal=64, cohort=16)
+        m = sim.run_manifest().to_dict()
+        assert m["config"]["cohort"] == {"size": 16,
+                                        "rounds_per_cohort": 1,
+                                        "peer_mode": "resample"}
+        assert m["config"]["nominal_n"] == 64
+        assert m["config"]["topology"] == "Topology"
+        assert any("history_scale" in p
+                   for p, _ in m["config"]["partition_rules"])
+        mb = m["memory_budget"]
+        assert mb["cohort_pool_resident"] == pool_bytes(sim)
+        assert mb["nominal_n"] == 64 and mb["cohort_size"] == 16
+
+    def test_config_roundtrip_and_run_experiment(self):
+        from gossipy_tpu.config import ExperimentConfig, run_experiment
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(400, D)).astype(np.float32)
+        y = (X @ rng.normal(size=D) > 0).astype(np.int64)
+        cfg = ExperimentConfig(n_nodes=48, model="logreg",
+                               topology="random_regular",
+                               topology_params={"degree": 4},
+                               cohort={"size": 12}, n_rounds=5, delta=10,
+                               batch_size=8, seed=3)
+        cfg2 = ExperimentConfig.from_json(cfg.to_json())
+        assert cfg2.cohort == {"size": 12}
+        pool, rep = run_experiment(cfg2, data=(X, y))
+        assert isinstance(pool, CohortPool)
+        assert (rep.cohort_active_nodes == 12).all()
+        with pytest.raises(ValueError, match="simulator 'gossip'"):
+            run_experiment(ExperimentConfig(
+                n_nodes=48, simulator="all2all", cohort={"size": 12}),
+                data=(X, y))
+        with pytest.raises(ValueError, match="repetition"):
+            ExperimentConfig(n_nodes=48, cohort={"size": 12},
+                             repetitions=2)
+
+    def test_service_rejects_cohort(self):
+        from gossipy_tpu.config import ExperimentConfig
+        from gossipy_tpu.service import RunRequest
+        cfg = ExperimentConfig(n_nodes=48, cohort={"size": 12})
+        with pytest.raises(ValueError, match="megabatch"):
+            RunRequest("alice", cfg)
+
+    def test_sentinels_compose(self, key):
+        sim = make_sim(nominal=64, cohort=16, sentinels=True)
+        pool = sim.init_cohort_pool(key)
+        pool, rep = sim.start(pool, n_rounds=4, key=key)
+        assert rep.health_trip is not None
+        assert (rep.health_trip == 0).all()
+
+
+class TestInducedSubgraph:
+    def test_induced_runs_and_respects_edges(self, key):
+        # A ring at nominal 64 with a 32-node cohort: the induced
+        # subgraph has SOME edges but also isolated nodes — sends from
+        # isolated nodes are skipped, so sent < C on typical rounds,
+        # and never exceeds C.
+        topo = SparseTopology.ring(64)
+        sim = GossipSimulator(
+            make_handler(), topo, make_data(64), delta=20,
+            protocol=AntiEntropyProtocol.PUSH,
+            cohort=CohortConfig(size=32, peer_mode="induced"))
+        pool = sim.init_cohort_pool(key)
+        pool, rep = sim.start(pool, n_rounds=6, key=key)
+        assert (rep.sent_per_round <= 32).all()
+        assert rep.sent_per_round.sum() > 0
+        assert (rep.cohort_active_nodes == 32).all()
+
+    def test_full_cohort_induced_equals_population_graph(self, key):
+        # C == N: the induced subgraph IS the whole graph every round —
+        # every node has ring neighbors, so every node sends.
+        topo = SparseTopology.ring(24)
+        sim = GossipSimulator(
+            make_handler(), topo, make_data(24), delta=20,
+            protocol=AntiEntropyProtocol.PUSH,
+            cohort=CohortConfig(size=24, peer_mode="induced"))
+        pool = sim.init_cohort_pool(key)
+        pool, rep = sim.start(pool, n_rounds=4, key=key)
+        assert (rep.sent_per_round == 24).all()
+
+
+class TestMillionNodePool:
+    def test_nominal_1m_pure_averaging_converges(self, key):
+        """The acceptance rung: nominal N = 1M, C = 4096 materialized,
+        lr = 0 (the local update is a no-op — the run is pure sampled
+        gossip averaging). Each round contracts the pool's parameter
+        variance by ~C/(2N); over 30 rounds the total variance must
+        visibly shrink while only [4096]-wide state ever exists on
+        device."""
+        n, c, rounds = 1_000_000, 4096, 30
+        sim = GossipSimulator(
+            make_handler(lr=0.0), NominalTopology(n), make_data(64),
+            delta=20, protocol=AntiEntropyProtocol.PUSH,
+            eval_every=rounds, sampling_eval=0.01,
+            cohort=CohortConfig(size=c))
+        assert sim.n_nodes == c and sim.nominal_n == n
+        pool = sim.init_cohort_pool(key)
+
+        def pool_variance(p):
+            flats = [np.asarray(l).reshape(n, -1)
+                     for l in jax.tree_util.tree_leaves(p.model.params)]
+            flat = np.concatenate(flats, axis=1)
+            return float(((flat - flat.mean(0)) ** 2).sum())
+
+        v0 = pool_variance(pool)
+        assert v0 > 0
+        pool, rep = sim.start(pool, n_rounds=rounds, key=key)
+        v1 = pool_variance(pool)
+        # ~C/(2N) contraction per round => >= ~4% over 30 rounds; assert
+        # a conservative bound plus strict decrease.
+        assert v1 < 0.97 * v0, (v0, v1)
+        # Coverage accounting at scale: ~ rounds*C/N of the pool touched
+        # (minus overlaps), monotone.
+        cov = rep.cohort_coverage
+        assert (np.diff(cov) >= -1e-9).all()
+        expected = rounds * c / n
+        assert 0.5 * expected < cov[-1] <= expected + 1e-9
+        # The materialized prediction names why this mode exists: the
+        # full-population round state would be ~N/C times the active.
+        mb = sim.memory_budget()
+        assert mb["cohort_materialized_prediction"] \
+            > 20 * mb["cohort_active_total"]
+
+    def test_pool_checkpoint_roundtrip_at_scale(self, key, tmp_path):
+        # Mid-run save/restore with the cheap zero template (no O(N)
+        # init on restore) — pool intact bit-for-bit.
+        n, c = 1_000_000, 256
+        sim = GossipSimulator(
+            make_handler(lr=0.0), NominalTopology(n), make_data(64),
+            delta=20, protocol=AntiEntropyProtocol.PUSH, eval_every=4,
+            cohort=CohortConfig(size=c))
+        pool = sim.init_cohort_pool(key, common_init=True)
+        pool, _ = sim.start(pool, n_rounds=2, key=key)
+        path = sim.save(str(tmp_path / "ck"), pool, key=key)
+        restored, rkey = sim.load(path, key)
+        assert int(np.asarray(restored.round)) == 2
+        for a, b in zip(jax.tree_util.tree_leaves(pool),
+                        jax.tree_util.tree_leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Continuation equals the uninterrupted run.
+        cont, _ = sim.start(restored, n_rounds=2, key=rkey)
+        direct, _ = sim.start(pool, n_rounds=2, key=key)
+        for a, b in zip(jax.tree_util.tree_leaves(cont.model),
+                        jax.tree_util.tree_leaves(direct.model)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestReportRoundTrip:
+    def test_cohort_fields_survive_save_load_concatenate(self, key):
+        from gossipy_tpu.simulation.report import SimulationReport
+        sim = make_sim(nominal=64, cohort=16)
+        pool = sim.init_cohort_pool(key)
+        pool, r1 = sim.start(pool, n_rounds=3, key=key)
+        pool, r2 = sim.start(pool, n_rounds=3, key=key)
+        d = r1.to_dict()
+        json.dumps(d)  # strict-JSON clean
+        back = SimulationReport.from_dict(d)
+        np.testing.assert_allclose(back.cohort_coverage,
+                                   r1.cohort_coverage, rtol=1e-6)
+        assert back.cohort_active_nodes.dtype.kind == "i"
+        cat = SimulationReport.concatenate([r1, r2])
+        assert cat.cohort_coverage.shape == (6,)
+        assert (cat.cohort_active_nodes == 16).all()

@@ -209,7 +209,7 @@ class TestEngineIntegration:
         assert len(rows_cb) == 3
         assert all(r["perf"]["round_ms"] > 0 for r in rows_cb)
         lines = [JSONLinesReceiver.parse_line(l) for l in open(path)]
-        assert all(r["schema"] == 7 for r in lines)
+        assert all(r["schema"] == 8 for r in lines)
         assert all(r["perf"] is not None and r["perf"]["round_ms"] > 0
                    for r in lines)
 
@@ -217,7 +217,7 @@ class TestEngineIntegration:
         sim = make_sim(perf=True)
         st = sim.init_nodes(key)
         st, rep = sim.start(st, n_rounds=3, key=key)
-        assert REPORT_SCHEMA == 6
+        assert REPORT_SCHEMA == 7
         path = rep.save(str(tmp_path / "r.json"))
         loaded = SimulationReport.load(path)
         np.testing.assert_allclose(loaded.perf_round_ms,
@@ -437,7 +437,7 @@ class TestSchemaV6:
         v1 = json.dumps({"schema": 1, "round": 1, "sent": 1, "failed": 0,
                          "size": 2, "local": None, "global": None})
         assert JSONLinesReceiver.parse_line(v1)["perf"] is None
-        assert JSONLinesReceiver.SCHEMA == 7  # v7: + "metrics"
+        assert JSONLinesReceiver.SCHEMA == 8  # v8: + "cohort"
 
     def test_report_from_dict_tolerates_missing_perf(self):
         rep = SimulationReport(metric_names=["accuracy"],
@@ -446,7 +446,7 @@ class TestSchemaV6:
                                failed=np.zeros(2, np.int64),
                                total_size=4)
         d = rep.to_dict()
-        assert d["schema"] == 6 and d["perf_round_ms"] is None
+        assert d["schema"] == 7 and d["perf_round_ms"] is None
         back = SimulationReport.from_dict(d)
         assert back.perf_round_ms is None
 

@@ -360,7 +360,7 @@ class TestReportAndEvents:
             st = sim.init_nodes(key)
             sim.start(st, n_rounds=3, key=key)
         rows = [JSONLinesReceiver.parse_line(l) for l in open(path)]
-        assert all(r["schema"] == 7 for r in rows)  # v7: + "metrics"
+        assert all(r["schema"] == 8 for r in rows)  # v8: + "cohort"
         assert all(r["health"] is not None for r in rows)
         assert all(r["health"]["trip"] is False for r in rows)
         assert all(r["probes"] is None for r in rows)  # probes off here

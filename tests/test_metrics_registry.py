@@ -321,31 +321,31 @@ class TestSinkTerminalSnapshot:
 
 
 class TestJSONLSchemaV7:
-    def test_parse_line_v1_to_v7_roundtrip(self):
+    def test_parse_line_v1_to_v8_roundtrip(self):
         from gossipy_tpu.simulation.events import JSONLinesReceiver
-        assert JSONLinesReceiver.SCHEMA == 7
+        assert JSONLinesReceiver.SCHEMA == 8
         base = {"round": 1, "sent": 2, "failed": 0, "size": 4,
                 "local": None, "global": None}
         v = dict(base)
         by_version = {1: dict(v)}
         for schema, field in ((2, "failed_by_cause"), (3, "probes"),
                               (4, "health"), (5, "chaos"), (6, "perf"),
-                              (7, "metrics")):
+                              (7, "metrics"), (8, "cohort")):
             v = dict(v)
             v[field] = None
             by_version[schema] = dict(v)
         for schema, row in by_version.items():
             row = dict(row, schema=schema)
             parsed = JSONLinesReceiver.parse_line(json.dumps(row))
-            # Every version normalizes to the v7 shape: all fields
+            # Every version normalizes to the v8 shape: all fields
             # present, absent ones null, nothing else invented.
             for field in ("failed_by_cause", "probes", "health",
-                          "chaos", "perf", "metrics"):
+                          "chaos", "perf", "metrics", "cohort"):
                 assert field in parsed and parsed[field] is None
             assert parsed["round"] == 1
         # Unknown future fields pass through untouched.
-        v8 = dict(by_version[7], schema=8, shiny="new")
-        assert JSONLinesReceiver.parse_line(json.dumps(v8))["shiny"] \
+        v9 = dict(by_version[8], schema=9, shiny="new")
+        assert JSONLinesReceiver.parse_line(json.dumps(v9))["shiny"] \
             == "new"
 
 

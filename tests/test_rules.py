@@ -1,0 +1,230 @@
+"""Partition-rule registry (parallel/rules.py): coverage + derivation.
+
+The acceptance contract of ISSUE-14's tentpole: every sharding in
+``parallel/`` derives from the rule registry, an unmatched state leaf is
+an ERROR (not a silent replicate), and no hand-placed ``PartitionSpec``
+exists outside ``rules.py``.
+"""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gossipy_tpu.core import AntiEntropyProtocol, Topology
+from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+from gossipy_tpu.handlers import SGDHandler, losses
+from gossipy_tpu.models import MLP
+from gossipy_tpu.parallel import (
+    STATE_RULES,
+    UnmatchedLeafError,
+    make_mesh,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    partition_specs,
+    shard_data,
+    state_shardings,
+)
+from gossipy_tpu.parallel.rules import (
+    named_leaves,
+    resolved_rules_table,
+    rules_table,
+)
+from gossipy_tpu.simulation import GossipSimulator
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def build(n_nodes=16, history_dtype="float32"):
+    rng = np.random.default_rng(0)
+    d = 6
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n_nodes * 12, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.25),
+                          n=n_nodes)
+    handler = SGDHandler(model=MLP(d, 2, hidden_dims=(8,)),
+                         loss=losses.cross_entropy, optimizer=optax.sgd(0.2),
+                         local_epochs=1, batch_size=4, n_classes=2,
+                         input_shape=(d,))
+    sim = GossipSimulator(handler, Topology.clique(n_nodes), disp.stacked(),
+                          delta=10, protocol=AntiEntropyProtocol.PUSH,
+                          history_dtype=history_dtype)
+    return sim, disp
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+class TestCoverage:
+    def test_every_state_leaf_matches_a_rule(self, key):
+        sim, _ = build()
+        st = sim.init_nodes(key)
+        specs = match_partition_rules(STATE_RULES, st)
+        assert jax.tree_util.tree_structure(specs) \
+            == jax.tree_util.tree_structure(st)
+
+    def test_int8_sidecar_and_aux_leaves_covered(self, key):
+        # The history_scale sidecars and variant aux state are exactly
+        # the leaf families a hand-placed scheme forgets.
+        from gossipy_tpu.simulation import PENSGossipSimulator
+        sim, disp = build(history_dtype="int8")
+        st = sim.init_nodes(key)
+        table = dict(resolved_rules_table(st))
+        scale_rows = [p for p in table if p.startswith("history_scale/")]
+        assert scale_rows and all(table[p] == "node_axis@1"
+                                  for p in scale_rows)
+
+        n_nodes, d = 16, 6
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(n_nodes * 12, d)).astype(np.float32)
+        y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+        disp = DataDispatcher(
+            ClassificationDataHandler(X, y, test_size=0.25), n=n_nodes)
+        from gossipy_tpu.core import CreateModelMode
+        handler = SGDHandler(model=MLP(d, 2, hidden_dims=(8,)),
+                             loss=losses.cross_entropy,
+                             optimizer=optax.sgd(0.2), local_epochs=1,
+                             batch_size=4, n_classes=2, input_shape=(d,),
+                             create_model_mode=CreateModelMode.MERGE_UPDATE)
+        pens = PENSGossipSimulator(handler, Topology.clique(n_nodes),
+                                   disp.stacked(), delta=10, n_sampled=4,
+                                   m_top=2, step1_rounds=3)
+        st_p = pens.init_nodes(key)
+        table_p = dict(resolved_rules_table(st_p))
+        aux_rows = [p for p in table_p if p.startswith("aux/")]
+        assert aux_rows and all(table_p[p] == "node_axis@0"
+                                for p in aux_rows)
+
+    def test_unmatched_leaf_raises(self):
+        tree = {"model": {"params": {"w": jnp.zeros((4, 2))}},
+                "mystery_field": jnp.zeros((4,))}
+        with pytest.raises(UnmatchedLeafError, match="mystery_field"):
+            match_partition_rules(STATE_RULES, tree)
+
+    def test_state_shardings_fails_on_unknown_state_leaf(self, key):
+        # The end-to-end coverage contract: a SimState grown a new field
+        # (simulated via a raw dict with an unknown key) cannot be
+        # silently placed.
+        mesh = make_mesh(8)
+        with pytest.raises(UnmatchedLeafError):
+            partition_specs({"new_sidecar": jnp.zeros((4, 4))}, mesh)
+
+
+class TestDerivation:
+    def test_state_shardings_equal_rule_resolution(self, key):
+        sim, _ = build()
+        st = sim.init_nodes(key)
+        mesh = make_mesh(8)
+        sh = state_shardings(st, mesh)
+        # Spot-check the resolved families against the table semantics.
+        for _, s in named_leaves(jax.tree.map(lambda x: x.spec,
+                                              sh.model.params)):
+            assert s[0] == "nodes"
+        assert sh.mailbox.sender.spec[1] == "nodes"
+        assert sh.history_ages.spec[1] == "nodes"
+        assert sh.round.spec == ()
+        assert sh.phase.spec[0] == "nodes"
+
+    def test_batch_dims_shift(self, key):
+        # Megabatch placement: a leading [T] lane axis stays replicated,
+        # the node axis moves one right (the scheduler's mesh path).
+        sim, _ = build()
+        st = sim.init_nodes(key)
+        batched = jax.tree.map(
+            lambda l: (jnp.broadcast_to(l[None], (3,) + l.shape)
+                       if hasattr(l, "ndim") else l), st)
+        mesh = make_mesh(8)
+        sh = state_shardings(batched, mesh, batch_dims=1)
+        k = jax.tree_util.tree_leaves(sh.model.params)[0]
+        assert k.spec[0] is None and k.spec[1] == "nodes"
+        assert sh.mailbox.sender.spec[2] == "nodes"
+
+    def test_shard_and_gather_fns_roundtrip(self, key):
+        sim, _ = build()
+        st = sim.init_nodes(key)
+        mesh = make_mesh(8)
+        shard_fns, gather_fns = make_shard_and_gather_fns(st, mesh)
+        placed = jax.tree_util.tree_leaves(
+            jax.tree.map(lambda f, l: f(l), shard_fns, st))
+        assert len(placed[0].sharding.device_set) == 8
+        sharded = jax.tree.map(lambda f, l: f(l), shard_fns, st)
+        back = jax.tree.map(lambda f, l: f(l), gather_fns, sharded)
+        for a, b in zip(jax.tree_util.tree_leaves(st),
+                        jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_rules_table_stamp_shape(self):
+        table = rules_table()
+        assert all(len(row) == 2 for row in table)
+        pats = [p for p, _ in table]
+        assert any("history_scale" in p for p in pats)
+        assert any("mailbox" in p for p in pats)
+
+    def test_data_rules(self):
+        mesh = make_mesh(8)
+        data = {"xtr": np.zeros((16, 3, 4), np.float32),
+                "x_eval": np.zeros((40, 4), np.float32)}
+        out = shard_data(data, mesh)
+        assert out["xtr"].sharding.spec[0] == "nodes"
+        assert all(e is None for e in out["x_eval"].sharding.spec)
+
+
+class TestNoHandPlacedSpecs:
+    def test_parallel_package_constructs_specs_only_in_rules(self):
+        """No ``PartitionSpec(...)`` / ``P(...)`` constructor call exists
+        in parallel/ outside rules.py — the single-source-of-truth
+        contract (helpers in rules.py build every spec)."""
+        import ast
+        pkg = REPO / "gossipy_tpu" / "parallel"
+        for f in pkg.glob("*.py"):
+            if f.name == "rules.py":
+                continue
+            tree = ast.parse(f.read_text())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                assert name not in ("P", "PartitionSpec"), \
+                    f"hand-placed PartitionSpec at {f.name}:{node.lineno}"
+
+
+class TestSchedulerMeshPlacement:
+    def test_service_megabatch_places_via_registry(self, tmp_path):
+        """GossipService(mesh=): the bucket's stacked states land on the
+        mesh with the rule-derived batch_dims=1 placement and tenants
+        still finish with correct reports."""
+        from gossipy_tpu.config import ExperimentConfig
+        from gossipy_tpu.service import GossipService, RunQueue, RunRequest
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(240, 8)).astype(np.float32)
+        y = (X @ rng.normal(size=8) > 0).astype(np.int64)
+        cfg = ExperimentConfig(
+            n_nodes=16, model="logreg", topology="random_regular",
+            topology_params={"degree": 4}, n_rounds=4, delta=10,
+            eval_every=4, seed=1, batch_size=8)
+        mesh = make_mesh(8)
+        svc = GossipService(out_dir=str(tmp_path), slice_rounds=2,
+                            events_jsonl=False, mesh=mesh)
+        q = RunQueue()
+        h = q.submit(RunRequest("alice", cfg, data=(X, y)))
+        session = svc.session(q)
+        session.admit_pending()
+        rt = session.runtimes[0]
+        leaf = jax.tree_util.tree_leaves(rt.states.model.params)[0]
+        assert leaf.sharding.spec[1] == "nodes"  # lane axis replicated
+        assert len(leaf.sharding.device_set) == 8
+        while session.poll():
+            pass
+        session.finish()
+        assert h.status.value == "done"
+        assert h.report is not None

@@ -210,7 +210,7 @@ class TestReport:
         # allow_nan=False: NaN eval rows must have become nulls.
         text = json.dumps(d, allow_nan=False)
         back = json.loads(text)
-        assert back["schema"] == 6  # v6: + perf arrays (absent when off)
+        assert back["schema"] == 7  # v7: + cohort arrays (absent when off)
         assert back["global_evals"][1] == [None]
         assert back["failed_per_cause"]["drop"] == [1, 0, 1]
         path = rep.save(str(tmp_path / "report.json"))
@@ -395,7 +395,7 @@ class TestReceivers:
         rows = [json.loads(l) for l in open(path)]
         assert len(rows) == 4
         for i, row in enumerate(rows):
-            assert row["schema"] == 7  # v7: + "metrics" (null when off)
+            assert row["schema"] == 8  # v8: + "cohort" (null when off)
             assert set(row["failed_by_cause"]) == set(FAILURE_CAUSES)
             assert sum(row["failed_by_cause"].values()) == row["failed"]
             assert row["failed"] == rep.failed_per_round[i]
