@@ -77,6 +77,12 @@ CHAOS_INFO: dict = {}
 # off known accelerators). Merged into raw — EVERY bench row carries the
 # trio so a TPU window banks its on-chip evidence automatically.
 PERF_INFO: dict = {}
+# Tracing-on vs tracing-off throughput stamp (north-star mode): the
+# overhead of the opt-in host span tracer (telemetry.tracing; ISSUE-16
+# acceptance target < 2% — host-side only, one extra block_until_ready
+# per start), plus the traced run's critical-path account
+# (host_blocked_frac / overlap_frac from trace_report). Merged into raw.
+TRACING_INFO: dict = {}
 
 
 def emit(payload: dict) -> None:
@@ -208,7 +214,8 @@ def stamp_perf(sim) -> None:
 
 
 def build_sim(X, y, fused: bool = False, probes: bool = False,
-              sentinels: bool = False, chaos=None, perf: bool = False):
+              sentinels: bool = False, chaos=None, perf: bool = False,
+              tracing=None):
     """The bench configuration (shared by the throughput and to-accuracy
     modes): 100 nodes, LogReg SGD, MERGE_UPDATE, PUSH over a 20-regular
     graph, per-round global eval."""
@@ -237,18 +244,19 @@ def build_sim(X, y, fused: bool = False, probes: bool = False,
                            probes=probes,
                            sentinels=sentinels,
                            chaos=chaos,
-                           perf=perf)
+                           perf=perf,
+                           tracing=tracing)
 
 
 def bench_ours(X, y) -> float:
     import jax
 
     def run(fused: bool, probes: bool = False, sentinels: bool = False,
-            chaos=None, perf: bool = False
+            chaos=None, perf: bool = False, tracing=None
             ) -> tuple[float, float, object, object]:
         n_rounds = BENCH_ROUNDS_DEGRADED if DEGRADED else BENCH_ROUNDS
         sim = build_sim(X, y, fused, probes=probes, sentinels=sentinels,
-                        chaos=chaos, perf=perf)
+                        chaos=chaos, perf=perf, tracing=tracing)
         key = jax.random.PRNGKey(42)
         state = sim.init_nodes(key)
         # Warmup: trigger compilation of the scan (donate_state=False: the
@@ -256,6 +264,10 @@ def bench_ours(X, y) -> float:
         s2, _ = sim.start(state, n_rounds=n_rounds, key=key,
                           donate_state=False)
         jax.block_until_ready(s2.model.params)
+        if sim.tracer is not None:
+            # Traced A/B: the report should account the TIMED window only,
+            # not the compile-heavy warmup.
+            sim.tracer.clear()
         t0 = time.perf_counter()
         s3, report = sim.start(state, n_rounds=n_rounds, key=key)
         jax.block_until_ready(s3.model.params)
@@ -337,6 +349,35 @@ def bench_ours(X, y) -> float:
               file=sys.stderr)
     except Exception as e:  # the A/B must not kill the main measurement
         print(f"[bench] chaos A/B failed ({e!r})", file=sys.stderr)
+    try:
+        # Span-tracer overhead, measured the same way: the plain config
+        # with the host span tracer on, A/B'd against the tracing-off run
+        # above (which IS the default path — tracing=None compiles the
+        # identical program; the tracer is host-side only). ISSUE-16
+        # acceptance: < 2% on this config. The traced run also yields the
+        # critical-path account the row carries (host_blocked_frac).
+        from gossipy_tpu.telemetry.tracing import Tracer, trace_report
+        tr = Tracer(process_name="bench")
+        elapsed_t, _, _, _ = run(False, tracing=tr)
+        treport = trace_report(tr.snapshot())
+        ttot = treport["totals"]
+        TRACING_INFO.update({
+            "tracing_off_rounds_per_sec": round(n_rounds / elapsed, 2),
+            "tracing_on_rounds_per_sec": round(n_rounds / elapsed_t, 2),
+            "tracing_overhead_frac": round(
+                max(0.0, 1.0 - elapsed / elapsed_t), 4),
+            "host_blocked_frac": ttot["host_blocked_frac"],
+            "trace_overlap_frac": ttot["overlap_frac"],
+            "trace_host_blocked_ms": ttot["host_blocked_ms"],
+        })
+        print(f"[bench] tracing on: {n_rounds} rounds in {elapsed_t:.2f}s "
+              f"({n_rounds / elapsed_t:.1f} r/s; overhead "
+              f"{TRACING_INFO['tracing_overhead_frac']:.1%} vs tracing "
+              f"off; host blocked "
+              f"{TRACING_INFO['host_blocked_frac']:.1%} of wall)",
+              file=sys.stderr)
+    except Exception as e:  # the A/B must not kill the main measurement
+        print(f"[bench] tracing A/B failed ({e!r})", file=sys.stderr)
     stamp_wire_traffic(sim, report, n_rounds)
     stamp_perf(sim)
     emit_manifest(sim, f"north-star/{label}")
@@ -1699,6 +1740,7 @@ def main():
             **SENTINEL_INFO,
             **CHAOS_INFO,
             **PERF_INFO,
+            **TRACING_INFO,
             "ours_rounds_per_sec": round(ours, 2),
             "ours_rounds_measured": (BENCH_ROUNDS_DEGRADED if DEGRADED
                                      else BENCH_ROUNDS),

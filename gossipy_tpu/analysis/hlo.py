@@ -214,6 +214,10 @@ def gate_cases() -> dict:
         # SLO registry feed must be HLO-invisible even when ON.
         ("engine/metrics-on",
          lambda: _make_sim(), lambda: _make_sim(metrics=True)),
+        # span tracing (telemetry.tracing) is host-side only, like perf
+        # and metrics: a live tracer must be HLO-invisible even when ON.
+        ("engine/tracing-on",
+         lambda: _make_sim(), lambda: _make_sim(tracing=True)),
         ("all2all/sentinels-off",
          lambda: _make_sim(all2all=True),
          lambda: _make_sim(all2all=True, sentinels=None)),

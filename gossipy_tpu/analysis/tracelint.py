@@ -56,6 +56,10 @@ metrics-in-trace    a call that resolves into ``telemetry.metrics``
                     traced root — metrics are host-side sinks, same
                     contract as io_callback bodies; record after the
                     run, or from inside a host callback
+trace-in-trace      a call that resolves into ``telemetry.tracing``
+                    (span/counter/tracer APIs) reachable from a traced
+                    root — the span tracer is a host-side sink under the
+                    same contract; span host segments, not jitted code
 =================== =====================================================
 
 Suppression: append ``# tracelint: disable=<rule>[,<rule>...]`` (or
@@ -84,6 +88,7 @@ ALL_RULES = {
     "registry-field": "per-round stat key missing from the report registry",
     "schema-tolerance": "JSONL SCHEMA bumped past parse_line's tolerance",
     "metrics-in-trace": "telemetry.metrics registry call in a traced region",
+    "trace-in-trace": "telemetry.tracing span/tracer call in a traced region",
 }
 
 # The SLO metrics registry (telemetry.metrics) is a HOST sink by
@@ -92,6 +97,13 @@ ALL_RULES = {
 # best it concretizes a tracer into a counter, at worst it silently
 # records trace-time constants once per compile instead of run values.
 _METRICS_MODULE = "gossipy_tpu/telemetry/metrics.py"
+
+# The span tracer (telemetry.tracing) is the SAME kind of host sink:
+# spans time host segments around jitted calls, never inside them. A
+# tracer call reachable from a traced root would record trace-time
+# nonsense once per compile — and wall timestamps are meaningless inside
+# a trace anyway.
+_TRACING_MODULE = "gossipy_tpu/telemetry/tracing.py"
 
 # Call-name suffix -> positions of function-valued operands that are traced.
 # None means "every positional argument from index 0" (switch: from 1).
@@ -1167,26 +1179,41 @@ def run_tracelint(root, sources: Optional[dict] = None,
 
     findings: list[Finding] = []
 
-    def _metrics_finding(mod: _Module, node: ast.Call):
+    def _host_sink_finding(rule: str, message: str, mod: _Module,
+                           node: ast.Call):
         line = getattr(node, "lineno", 1)
         text = mod.lines[line - 1].strip() \
             if 0 < line <= len(mod.lines) else ""
         findings.append(Finding(
-            rule="metrics-in-trace", path=mod.relpath, line=line,
-            col=getattr(node, "col_offset", 0),
-            message="telemetry.metrics registry call reachable from a "
-                    "traced root — metrics are host-side sinks (same "
-                    "contract as io_callback bodies); record after the "
-                    "run or from inside a host callback",
+            rule=rule, path=mod.relpath, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
             snippet=text))
+
+    def _metrics_finding(mod: _Module, node: ast.Call):
+        _host_sink_finding(
+            "metrics-in-trace",
+            "telemetry.metrics registry call reachable from a "
+            "traced root — metrics are host-side sinks (same "
+            "contract as io_callback bodies); record after the "
+            "run or from inside a host callback", mod, node)
+
+    def _tracing_finding(mod: _Module, node: ast.Call):
+        _host_sink_finding(
+            "trace-in-trace",
+            "telemetry.tracing span/tracer call reachable from a "
+            "traced root — the span tracer is a host-side sink (same "
+            "contract as io_callback bodies and the metrics registry); "
+            "span the host segment around the jitted call instead",
+            mod, node)
 
     # Propagate tracedness through repo-internal calls. Only a function's
     # OWN code propagates — nested defs are separate regions reached via
     # resolve_call (so an io_callback body inside a traced method never
     # drags its host-side helpers into the traced set). A call resolving
-    # into telemetry.metrics does NOT propagate — it is reported as a
-    # metrics-in-trace finding instead (the registry is a host sink by
-    # contract; tracing into it would also mis-lint its own host code).
+    # into telemetry.metrics or telemetry.tracing does NOT propagate — it
+    # is reported as a metrics-in-trace / trace-in-trace finding instead
+    # (both are host sinks by contract; tracing into them would also
+    # mis-lint their own host code).
     while worklist:
         fn = worklist.pop()
         mod = modules[fn.module]
@@ -1195,6 +1222,8 @@ def run_tracelint(root, sources: Optional[dict] = None,
                 for callee in repo.resolve_call(mod, node, fn):
                     if callee.module == _METRICS_MODULE:
                         _metrics_finding(mod, node)
+                    elif callee.module == _TRACING_MODULE:
+                        _tracing_finding(mod, node)
                     else:
                         add(callee)
     for fn in traced.values():

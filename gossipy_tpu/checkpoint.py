@@ -80,15 +80,21 @@ def save_checkpoint(path: str, state: Any, key: Optional[jax.Array] = None,
     Returns the absolute checkpoint path.
     """
     import json
+
+    from .telemetry.tracing import span
     path = os.path.abspath(path)
-    payload = {"state": state}
-    if key is not None:
-        payload["key"] = key
-    _checkpointer().save(path, payload, force=force)
-    if meta is not None:
-        with open(path + ".meta.json", "w") as fh:
-            json.dump(meta, fh, indent=2)
-            fh.write("\n")
+    # Process-default tracer resolved at enter time: checkpoint writes
+    # appear on the run's timeline whenever tracing is on, and cost one
+    # no-op handle when it is off.
+    with span("checkpoint.save", cat="checkpoint", path=path):
+        payload = {"state": state}
+        if key is not None:
+            payload["key"] = key
+        _checkpointer().save(path, payload, force=force)
+        if meta is not None:
+            with open(path + ".meta.json", "w") as fh:
+                json.dump(meta, fh, indent=2)
+                fh.write("\n")
     return path
 
 
@@ -133,6 +139,8 @@ def restore_checkpoint(path: str, template_state: Any,
     """
     import orbax.checkpoint as ocp
 
+    from .telemetry.tracing import span
+
     def attempt(template):
         # Restore INTO the template's shardings/dtypes (not the
         # file-recorded ones) so a checkpoint written on one mesh topology
@@ -146,12 +154,14 @@ def restore_checkpoint(path: str, template_state: Any,
     # template first (defaulting one when the caller didn't pass it), then
     # without.
     key_tmpl = template_key if template_key is not None else jax.random.PRNGKey(0)
-    try:
-        restored = attempt({"state": template_state, "key": key_tmpl})
-        return restored["state"], restored["key"]
-    except ValueError:
-        restored = attempt({"state": template_state})
-        return restored["state"], None
+    with span("checkpoint.restore", cat="checkpoint",
+              path=os.path.abspath(path)):
+        try:
+            restored = attempt({"state": template_state, "key": key_tmpl})
+            return restored["state"], restored["key"]
+        except ValueError:
+            restored = attempt({"state": template_state})
+            return restored["state"], None
 
 
 class CheckpointManager:

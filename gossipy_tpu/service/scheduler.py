@@ -66,6 +66,7 @@ from ..checkpoint import slice_lane
 from ..simulation.engine import BATCH_AXIS
 from ..simulation.events import JSONLinesReceiver, SimulationEventSender
 from ..telemetry import RunManifest, emit_event
+from ..telemetry import tracing as _tracing
 from ..telemetry.health import FlightRecorder
 from ..telemetry.metrics import MetricsRegistry, get_registry
 from .packer import Bucket, BuiltRun, build_request, pack
@@ -114,6 +115,11 @@ def _service_metrics(reg: MetricsRegistry) -> dict:
             "service_tenant_seconds_total",
             "per-tenant share of measured bucket wall time "
             "(the fair-share currency)", ("tenant",)),
+        "host_blocked": reg.gauge(
+            "service_host_blocked_frac",
+            "fraction of the bucket's cumulative slice wall spent in "
+            "host-side work (trace-derived; compile + harvest + repro "
+            "copies vs the device execution wait)", ("bucket",)),
     }
 
 
@@ -130,12 +136,18 @@ class _BucketRuntime:
     def __init__(self, bucket: Bucket, out_root: str, slice_rounds: int,
                  keep_repro: bool, events_jsonl: bool,
                  registry: Optional[MetricsRegistry] = None,
-                 mesh=None):
+                 mesh=None, tracer=None):
         self.bucket = bucket
         self.mesh = mesh
         self._reg = registry if registry is not None else get_registry()
         self._m = _service_metrics(self._reg)
         self._digest8 = bucket.signature.digest[:8]
+        # Host-side span tracer (telemetry.tracing), shared across the
+        # session's buckets: slice/compile spans, the tenant lifecycle
+        # async track, and the host_blocked accounting below.
+        self.tracer = tracer
+        self._hb_host = 0.0   # cumulative non-wait host seconds
+        self._hb_wall = 0.0   # cumulative slice wall seconds
         self._queue_wait: dict[int, float] = {}
         self.sim = bucket.runs[0].sim  # the representative: ONLY sim run
         self.slice_rounds = int(slice_rounds)
@@ -281,28 +293,39 @@ class _BucketRuntime:
             wait = max(t_adm - r.handle.submitted_at, 0.0)
             self._queue_wait[i] = wait
             self._m["queue_wait"].labels(bucket=self._digest8).observe(wait)
+            if self.tracer is not None:
+                # The tenant's lifecycle async track opens at admission;
+                # first-round and finish markers land in step()/_finalize.
+                self.tracer.begin_async(
+                    "tenant", aid=r.tenant, bucket=self._digest8,
+                    queue_wait_s=round(wait, 3))
         self._m["admitted"].labels(bucket=self._digest8).inc(
             self.bucket.size)
-        t0 = time.perf_counter()
-        self._init_fn = self._make_init()
-        self._step_fn = self._make_step()
-        if self.mesh is not None:
-            # Megabatch placement derives from the partition-rule
-            # registry (parallel/rules.py): the stacked [T, ...] tenant
-            # data and the [T, N, ...] state batch shard their node axis
-            # per the same table as solo runs — batch_dims=1 shifts every
-            # rule's node position past the replicated lane axis.
-            from ..parallel import shard_data
-            self.data = shard_data(self.data, self.mesh, batch_dims=1)
-        self.states = self._init_fn(self.keys, self.data)
-        if self.mesh is not None:
-            from ..parallel import shard_state
-            self.states = shard_state(self.states, self.mesh,
-                                      batch_dims=1)
-        jax.block_until_ready(jax.tree.leaves(self.states)[0])
+        # The span handle is the ONE timing source: it feeds both the
+        # compile gauge and the trace (no parallel perf_counter local).
+        sp_i = _tracing.span("service.init", cat="service",
+                             tracer=self.tracer, bucket=self._digest8,
+                             program="init")
+        with sp_i:
+            self._init_fn = self._make_init()
+            self._step_fn = self._make_step()
+            if self.mesh is not None:
+                # Megabatch placement derives from the partition-rule
+                # registry (parallel/rules.py): the stacked [T, ...]
+                # tenant data and the [T, N, ...] state batch shard their
+                # node axis per the same table as solo runs —
+                # batch_dims=1 shifts every rule's node position past the
+                # replicated lane axis.
+                from ..parallel import shard_data
+                self.data = shard_data(self.data, self.mesh, batch_dims=1)
+            self.states = self._init_fn(self.keys, self.data)
+            if self.mesh is not None:
+                from ..parallel import shard_state
+                self.states = shard_state(self.states, self.mesh,
+                                          batch_dims=1)
+            jax.block_until_ready(jax.tree.leaves(self.states)[0])
         self._m["compile"].labels(bucket=self._digest8,
-                                  program="init").set_value(
-            time.perf_counter() - t0)
+                                  program="init").set_value(sp_i.duration)
         if self.sentinels_on:
             zero = self.sim._health_zero_carry()
             self.hc = jax.tree.map(
@@ -330,85 +353,145 @@ class _BucketRuntime:
         if not lanes:
             self.live = False
             return
-        if self.keep_repro:
-            # Host copies survive the donation of the batched source and
-            # become the bundle checkpoint if this slice trips a lane.
-            self._healthy = {i: slice_lane(self.states, i) for i in lanes}
-            self._healthy_round = self.rounds_done
         chunk_start = self.rounds_done
-        saved_axis = self.sim._batch_axis_name
-        self.sim._batch_axis_name = BATCH_AXIS
-        t_slice0 = time.perf_counter()
-        try:
+        # The slice is one trace "run window" (round_start/rounds args
+        # are what scripts/trace_report.py reduces on); the span handles
+        # replace the t_slice0/t_c0 perf_counter locals — compile vs
+        # execute seconds now come from ONE source each (the same span
+        # feeds the gauge/histogram AND the trace).
+        sp_slice = _tracing.span("service.slice", cat="service",
+                                 tracer=self.tracer, bucket=self._digest8,
+                                 round_start=chunk_start,
+                                 rounds=self.slice_rounds)
+        with sp_slice:
+            if self.keep_repro:
+                # Host copies survive the donation of the batched source
+                # and become the bundle checkpoint if this slice trips a
+                # lane.
+                with _tracing.span("service.snapshot_healthy",
+                                   cat="service", tracer=self.tracer):
+                    self._healthy = {i: slice_lane(self.states, i)
+                                     for i in lanes}
+                self._healthy_round = self.rounds_done
+            saved_axis = self.sim._batch_axis_name
+            self.sim._batch_axis_name = BATCH_AXIS
+            sp_c = None
+            # cat="host.wait": dispatch + completion wait (the host
+            # transfer forces it), not host work — the bridged device
+            # span below accounts the window.
+            sp_step = _tracing.span("service.step", cat=_tracing.WAIT_CAT,
+                                    tracer=self.tracer)
             try:
-                step_args = (self.states, self.keys, self.data, self.drop,
-                             self.online, self.hc, self.chaos_scheds)
-                if self._step_compiled is None:
-                    t_c0 = time.perf_counter()
-                    self._step_compiled = self._compile_step(step_args)
-                    self._m["compile"].labels(
-                        bucket=self._digest8, program="step").set_value(
-                        time.perf_counter() - t_c0)
-                self.states, self.hc, stats = self._step_compiled(
-                    *step_args)
-                host = jax.tree.map(np.asarray, stats)
-            except Exception as e:  # the whole bucket program died
-                self._fail_all(e, chunk_start)
-                return
-        finally:
-            self.sim._batch_axis_name = saved_axis
-        # The host transfer above forces completion, so this wall time is
-        # the slice's real cost, attributed evenly across live lanes.
-        slice_wall = time.perf_counter() - t_slice0
-        self._m["slice"].labels(bucket=self._digest8).observe(slice_wall)
-        self._m["round"].labels(bucket=self._digest8).observe(
-            slice_wall / max(self.slice_rounds, 1))
-        per_lane_round_flops = (
-            self._step_cost.flops / max(self.bucket.size, 1)
-            if self._step_cost is not None and self._step_cost.flops
-            else None)
-        if not self._cache_delta:
-            self._cache_delta = self._compute_cache_delta()
-        self.rounds_done += self.slice_rounds
+                try:
+                    step_args = (self.states, self.keys, self.data,
+                                 self.drop, self.online, self.hc,
+                                 self.chaos_scheds)
+                    if self._step_compiled is None:
+                        sp_c = _tracing.span("service.compile",
+                                             cat="service",
+                                             tracer=self.tracer,
+                                             bucket=self._digest8,
+                                             program="step")
+                        with sp_c:
+                            self._step_compiled = \
+                                self._compile_step(step_args)
+                        self._m["compile"].labels(
+                            bucket=self._digest8,
+                            program="step").set_value(sp_c.duration)
+                    with sp_step:
+                        self.states, self.hc, stats = \
+                            self._step_compiled(*step_args)
+                        host = jax.tree.map(np.asarray, stats)
+                except Exception as e:  # the whole bucket program died
+                    self._fail_all(e, chunk_start)
+                    return
+            finally:
+                self.sim._batch_axis_name = saved_axis
+            if self.tracer is not None:
+                _tracing.attach_device_spans(
+                    self.tracer, sp_step.ts_us, sp_step.dur_us,
+                    args={"bucket": self._digest8})
+            # The host transfer inside the step span forces completion,
+            # so compile + step wall is the slice's real cost, attributed
+            # evenly across live lanes (span-derived; glue excluded).
+            slice_wall = sp_step.duration + \
+                (sp_c.duration if sp_c is not None else 0.0)
+            self._m["slice"].labels(bucket=self._digest8).observe(
+                slice_wall)
+            self._m["round"].labels(bucket=self._digest8).observe(
+                slice_wall / max(self.slice_rounds, 1))
+            per_lane_round_flops = (
+                self._step_cost.flops / max(self.bucket.size, 1)
+                if self._step_cost is not None and self._step_cost.flops
+                else None)
+            if not self._cache_delta:
+                self._cache_delta = self._compute_cache_delta()
+            self.rounds_done += self.slice_rounds
 
-        for i in lanes:
-            run = self.bucket.runs[i]
-            h = run.handle
-            take = min(self.slice_rounds,
-                       self.requested[i] - h.rounds_completed)
-            rows = {k: v[i][:take] for k, v in host.items()}
-            trip_idx = None
-            if self.sentinels_on and "health_trip" in rows:
-                nz = np.nonzero(np.asarray(rows["health_trip"]) > 0)[0]
-                trip_idx = int(nz[0]) if nz.size else None
-            self._tenant_seconds[i] += slice_wall / len(lanes)
-            self._m["tenant_seconds"].labels(tenant=run.tenant).inc(
-                slice_wall / len(lanes))
-            if h.rounds_completed == 0 and take > 0:
-                # Time-to-first-round: the tenant's first completed round
-                # became observable when this slice's results landed.
-                h.first_round_at = time.time()
-                ttfr = max(h.first_round_at - h.submitted_at, 0.0)
-                self._m["ttfr"].observe(ttfr)
-                self._m["ttfr_tenant"].labels(
-                    tenant=run.tenant).set_value(ttfr)
-            if per_lane_round_flops is not None:
-                rounds_taken = take if trip_idx is None else trip_idx + 1
-                self._tenant_flops[i] += \
-                    per_lane_round_flops * rounds_taken
-            if trip_idx is not None:
-                rows = {k: v[:trip_idx + 1] for k, v in rows.items()}
-                self._harvest_rows(i, rows, chunk_start)
-                h.rounds_completed += trip_idx + 1
-                self._m["rounds"].labels(bucket=self._digest8).inc(
-                    trip_idx + 1)
-                self._evict(i, chunk_start + trip_idx, rows)
-            else:
-                self._harvest_rows(i, rows, chunk_start)
-                h.rounds_completed += take
-                self._m["rounds"].labels(bucket=self._digest8).inc(take)
-                if h.rounds_completed >= self.requested[i]:
-                    self._finalize(i, RunStatus.DONE)
+            sp_h = _tracing.span("service.harvest", cat="service",
+                                 tracer=self.tracer, bucket=self._digest8)
+            with sp_h:
+                for i in lanes:
+                    run = self.bucket.runs[i]
+                    h = run.handle
+                    take = min(self.slice_rounds,
+                               self.requested[i] - h.rounds_completed)
+                    rows = {k: v[i][:take] for k, v in host.items()}
+                    trip_idx = None
+                    if self.sentinels_on and "health_trip" in rows:
+                        nz = np.nonzero(
+                            np.asarray(rows["health_trip"]) > 0)[0]
+                        trip_idx = int(nz[0]) if nz.size else None
+                    self._tenant_seconds[i] += slice_wall / len(lanes)
+                    self._m["tenant_seconds"].labels(
+                        tenant=run.tenant).inc(slice_wall / len(lanes))
+                    if h.rounds_completed == 0 and take > 0:
+                        # Time-to-first-round: the tenant's first
+                        # completed round became observable when this
+                        # slice's results landed.
+                        h.first_round_at = time.time()
+                        ttfr = max(h.first_round_at - h.submitted_at, 0.0)
+                        self._m["ttfr"].observe(ttfr)
+                        self._m["ttfr_tenant"].labels(
+                            tenant=run.tenant).set_value(ttfr)
+                        if self.tracer is not None:
+                            self.tracer.async_instant(
+                                "first_round", aid=run.tenant,
+                                ttfr_s=round(ttfr, 3))
+                    if per_lane_round_flops is not None:
+                        rounds_taken = (take if trip_idx is None
+                                        else trip_idx + 1)
+                        self._tenant_flops[i] += \
+                            per_lane_round_flops * rounds_taken
+                    if trip_idx is not None:
+                        rows = {k: v[:trip_idx + 1]
+                                for k, v in rows.items()}
+                        self._harvest_rows(i, rows, chunk_start)
+                        h.rounds_completed += trip_idx + 1
+                        self._m["rounds"].labels(
+                            bucket=self._digest8).inc(trip_idx + 1)
+                        self._evict(i, chunk_start + trip_idx, rows)
+                    else:
+                        self._harvest_rows(i, rows, chunk_start)
+                        h.rounds_completed += take
+                        self._m["rounds"].labels(
+                            bucket=self._digest8).inc(take)
+                        if h.rounds_completed >= self.requested[i]:
+                            self._finalize(i, RunStatus.DONE)
+        # Per-bucket host-blocked accounting (the service_top column and
+        # the trace counter track): everything in the window except the
+        # device execution wait is host work; in this synchronous slice
+        # loop none of it overlaps the device, so blocked == host-busy.
+        self._hb_wall += sp_slice.duration
+        self._hb_host += max(sp_slice.duration - sp_step.duration, 0.0)
+        if self._hb_wall > 0:
+            frac = self._hb_host / self._hb_wall
+            self._m["host_blocked"].labels(
+                bucket=self._digest8).set_value(round(frac, 4))
+            if self.tracer is not None:
+                self.tracer.counter_event(
+                    f"host_blocked%/{self._digest8}",
+                    value=round(frac * 100.0, 2))
         if not self._live_lanes():
             self.live = False
 
@@ -549,6 +632,11 @@ class _BucketRuntime:
         h = run.handle
         h.status = status
         self._m["finished"].labels(status=status.value).inc()
+        if self.tracer is not None:
+            # Close the lifecycle async track opened at admission.
+            self.tracer.end_async("tenant", aid=run.tenant,
+                                  status=status.value,
+                                  rounds=h.rounds_completed)
         h.report = self._build_tenant_report(i)
         out = self.out_dirs[i]
         if h.report is not None:
@@ -679,7 +767,7 @@ class GossipService:
                  events_jsonl: bool = True,
                  metrics_dir: Optional[str] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 mesh=None):
+                 mesh=None, tracing=None):
         # Optional jax.sharding.Mesh: when given, every bucket's
         # megabatch state/data placement is derived from the partition-
         # rule registry (parallel/rules.py) instead of single-device
@@ -695,6 +783,17 @@ class GossipService:
         self.metrics_dir = (os.path.abspath(metrics_dir)
                             if metrics_dir else None)
         self.registry = registry if registry is not None else get_registry()
+        # Host-side span tracing (telemetry.tracing): same resolution
+        # contract as GossipSimulator(tracing=...) — None/False off,
+        # True = the process-default tracer, or an explicit Tracer.
+        # When on, every poll cycle also writes an atomic trace.json
+        # next to metrics.json (scripts/service_top.py reads both).
+        if tracing is None or tracing is False:
+            self.tracer = None
+        elif tracing is True:
+            self.tracer = _tracing.ensure_tracer()
+        else:
+            self.tracer = tracing
 
     def run(self, requests: list[RunRequest]) -> dict:
         """Serve a fixed batch of requests (sugar over :meth:`serve`)."""
@@ -778,7 +877,8 @@ class ServiceSession:
         })
         new = [_BucketRuntime(b, svc.out_dir, svc.slice_rounds,
                               svc.keep_repro, svc.events_jsonl,
-                              registry=svc.registry, mesh=svc.mesh)
+                              registry=svc.registry, mesh=svc.mesh,
+                              tracer=svc.tracer)
                for b in buckets]
         for rt in new:
             rt.initialize()
@@ -805,6 +905,11 @@ class ServiceSession:
         if self.service.metrics_dir:
             self.service.registry.save(
                 os.path.join(self.service.metrics_dir, "metrics.json"))
+            if self.service.tracer is not None:
+                # Atomic like metrics.json: a tailing service_top (or a
+                # mid-run Perfetto load) never reads a torn trace.
+                self.service.tracer.save(
+                    os.path.join(self.service.metrics_dir, "trace.json"))
 
     # -- completion --------------------------------------------------------
 

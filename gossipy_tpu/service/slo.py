@@ -83,7 +83,7 @@ def run_load(out_dir: str, pool: Optional[Sequence[dict]] = None,
              seed: int = 0, slice_rounds: int = 3,
              metrics_dir: Optional[str] = None,
              registry: Optional[MetricsRegistry] = None,
-             time_scale: float = 1.0) -> dict:
+             time_scale: float = 1.0, tracing=None) -> dict:
     """Run the sustained-arrival load and return ``{"row": service_slo
     bench row, "summary": service summary, "queue": RunQueue}``.
 
@@ -92,11 +92,18 @@ def run_load(out_dir: str, pool: Optional[Sequence[dict]] = None,
     without waiting out the nominal inter-arrival gaps; the reported
     ``offered_rate_per_hour`` uses the COMPRESSED schedule, so the row
     stays honest.
+
+    ``tracing`` follows the GossipService contract (None/True/Tracer):
+    when on, every arrival lands as an instant marker + queue-depth
+    counter on the service's trace timeline, and the session writes
+    ``trace.json`` next to ``metrics.json`` each poll cycle.
     """
     reg = registry if registry is not None else get_registry()
     pool = list(pool) if pool is not None else default_spec_pool()
     svc = GossipService(out_dir, slice_rounds=slice_rounds,
-                        metrics_dir=metrics_dir, registry=reg)
+                        metrics_dir=metrics_dir, registry=reg,
+                        tracing=tracing)
+    tracer = svc.tracer
     queue = RunQueue()
     session = svc.session(queue)
     requests = make_requests(pool, n_tenants, seed=seed)
@@ -109,6 +116,12 @@ def run_load(out_dir: str, pool: Optional[Sequence[dict]] = None,
         now = time.perf_counter() - t0
         while i < len(requests) and offsets[i] <= now:
             queue.submit(requests[i])
+            if tracer is not None:
+                tracer.instant("arrival", cat="loadgen",
+                               tenant=requests[i].tenant,
+                               offset_s=round(float(offsets[i]), 3))
+                tracer.counter_event("loadgen.pending",
+                                     value=float(len(queue.pending())))
             i += 1
         progressed = session.poll()   # admits + one slice per live bucket
         if not progressed and i < len(requests):

@@ -46,12 +46,13 @@ enforces it.
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..telemetry import tracing as _tracing
 
 # Report keys this layer adds (registered in report.PER_ROUND_FIELDS; the
 # tracelint registry-field rule covers the cohort_ prefix).
@@ -513,73 +514,119 @@ def cohort_start(sim, pool: CohortPool, n_rounds: int,
     seg_stats: list[dict] = []
     coverage: list[float] = []
     perf_on = sim.perf is not None and sim.perf.timing
-    t_run0 = time.perf_counter()
     any_cold = False
+    tr = getattr(sim, "tracer", None)
 
-    done = 0
-    while done < n_rounds:
-        seg = min(cfg.rounds_per_cohort, n_rounds - done)
-        r0 = first_round + done
-        fn, cache_k = _segment_fn(sim, seg)
-        cold = not getattr(fn, "_gossipy_warm", False)
+    # Every host segment is spanned (telemetry.tracing): the span handles
+    # are the ONE timing source — perf exec wall reads the outer span,
+    # last_compile_seconds reads the compile span — no parallel
+    # perf_counter locals to drift from what the trace shows. The
+    # per-segment "cohort.segment" span carries the round_start/rounds
+    # window args scripts/trace_report.py reduces on.
+    sp_all = _tracing.span("cohort.start", cat="cohort", tracer=tr,
+                           total_rounds=n_rounds, cohort_size=c)
+    with sp_all:
+        done = 0
+        while done < n_rounds:
+            seg = min(cfg.rounds_per_cohort, n_rounds - done)
+            r0 = first_round + done
+            with _tracing.span("cohort.segment", cat="cohort", tracer=tr,
+                               round_start=r0, rounds=seg):
+                fn, cache_k = _segment_fn(sim, seg)
+                cold = not getattr(fn, "_gossipy_warm", False)
 
-        idx = sample_cohort(key, r0, n, c)
-        jidx = jnp.asarray(idx)
-        sub_model = jax.tree.map(
-            lambda l: jnp.asarray(np.asarray(l)[idx]), pool.model)
-        phase_c = jnp.asarray(np.asarray(pool.phase)[idx])
-        aux = ()
-        if cfg.peer_mode == "induced":
-            aux = {"cohort_nbr": jnp.asarray(
-                _local_neighbor_table(sim, idx))}
-        data_c = {k: (v if k in ("x_eval", "y_eval")
-                      else v[jidx % p_rows])
-                  for k, v in sim.data.items()}
-        state = _active_state(sim, sub_model, phase_c, r0, aux)
+                with _tracing.span("cohort.sample", cat="cohort",
+                                   tracer=tr):
+                    idx = sample_cohort(key, r0, n, c)
+                    jidx = jnp.asarray(idx)
+                with _tracing.span("cohort.gather", cat="cohort",
+                                   tracer=tr):
+                    sub_model = jax.tree.map(
+                        lambda l: jnp.asarray(np.asarray(l)[idx]),
+                        pool.model)
+                    phase_c = jnp.asarray(np.asarray(pool.phase)[idx])
+                    aux = ()
+                    if cfg.peer_mode == "induced":
+                        aux = {"cohort_nbr": jnp.asarray(
+                            _local_neighbor_table(sim, idx))}
+                    data_c = {k: (v if k in ("x_eval", "y_eval")
+                                  else v[jidx % p_rows])
+                              for k, v in sim.data.items()}
+                    state = _active_state(sim, sub_model, phase_c, r0,
+                                          aux)
 
-        args = (state, key, data_c, jnp.int32(last_round))
-        if sim.sentinels is not None:
-            hc = (sim._health_carry if sim._health_carry is not None
-                  else sim._health_zero_carry())
-            args = args + (hc,)
-        if cold:
-            any_cold = True
-            t_c0 = time.perf_counter()
-            if sim.perf is not None and sim.perf.cost:
-                # The start() AOT detour: bank the segment program's own
-                # cost/memory analysis at compile time.
-                try:
-                    compiled = fn.lower(*args).compile()
-                except Exception:
-                    pass
+                args = (state, key, data_c, jnp.int32(last_round))
+                if sim.sentinels is not None:
+                    hc = (sim._health_carry
+                          if sim._health_carry is not None
+                          else sim._health_zero_carry())
+                    args = args + (hc,)
+                if cold:
+                    any_cold = True
+                    if sim.perf is not None and sim.perf.cost:
+                        # The start() AOT detour: bank the segment
+                        # program's own cost/memory analysis at compile
+                        # time. The span IS the compile measurement.
+                        sp_c = _tracing.span(
+                            "cohort.compile", cat="cohort", tracer=tr,
+                            program=f"cohort[{seg}r/C{c}]")
+                        with sp_c:
+                            try:
+                                compiled = fn.lower(*args).compile()
+                            except Exception:
+                                compiled = None
+                        if compiled is not None:
+                            sim._record_cost(
+                                compiled,
+                                label=f"cohort_start[{seg}r/C{c}]",
+                                n_rounds=seg)
+                            sim._jit_cache[cache_k] = compiled
+                            fn = compiled
+                            if sim.last_compile_seconds is None:
+                                sim.last_compile_seconds = sp_c.duration
+                    try:
+                        fn._gossipy_warm = True  # jit wrappers take attrs
+                    except Exception:
+                        pass
+                # cat="host.wait": dispatch + completion wait, not host
+                # work; the bridged device span below accounts for it.
+                sp_r = _tracing.span("cohort.run", cat=_tracing.WAIT_CAT,
+                                     tracer=tr)
+                with sp_r:
+                    out = fn(*args)
+                    if tr is not None:
+                        # The run span must close at execution end, not
+                        # at async dispatch (the scatter below would
+                        # otherwise absorb the device wait invisibly).
+                        jax.block_until_ready(out)
+                if tr is not None:
+                    _tracing.attach_device_spans(
+                        tr, sp_r.ts_us, sp_r.dur_us,
+                        args={"segment_rounds": seg})
+                if sim.sentinels is not None:
+                    final_state, sim._health_carry, stats = out
                 else:
-                    sim._record_cost(
-                        compiled, label=f"cohort_start[{seg}r/C{c}]",
-                        n_rounds=seg)
-                    sim._jit_cache[cache_k] = compiled
-                    fn = compiled
-            try:
-                fn._gossipy_warm = True  # jit wrappers take attributes
-            except Exception:
-                pass
-        out = fn(*args)
-        if sim.sentinels is not None:
-            final_state, sim._health_carry, stats = out
-        else:
-            final_state, stats = out
-        if cold and sim.last_compile_seconds is None:
-            sim.last_compile_seconds = time.perf_counter() - t_c0
+                    final_state, stats = out
+                if cold and sim.last_compile_seconds is None:
+                    # No AOT detour: the cold dispatch folded tracing +
+                    # compilation — the run span is the best available
+                    # compile wall (plus execution when a tracer forced
+                    # the sync above; same caveat as engine.start).
+                    sim.last_compile_seconds = sp_r.duration
 
-        # Scatter the durable state back into the pool (host).
-        for dst, src in zip(model_leaves,
-                            jax.tree.leaves(final_state.model)):
-            dst[idx] = np.asarray(src)
-        pool.phase[idx] = np.asarray(final_state.phase)
-        touched[idx] = True
-        cov = float(touched.mean())
-        coverage.extend([cov] * seg)
-        seg_stats.append(jax.tree.map(np.asarray, stats))
-        done += seg
+                # Scatter the durable state back into the pool (host).
+                with _tracing.span("cohort.scatter", cat="cohort",
+                                   tracer=tr):
+                    for dst, src in zip(model_leaves,
+                                        jax.tree.leaves(
+                                            final_state.model)):
+                        dst[idx] = np.asarray(src)
+                    pool.phase[idx] = np.asarray(final_state.phase)
+                    touched[idx] = True
+                cov = float(touched.mean())
+                coverage.extend([cov] * seg)
+                seg_stats.append(jax.tree.map(np.asarray, stats))
+            done += seg
 
     stats_all: dict = {}
     for k in seg_stats[0]:
@@ -588,9 +635,8 @@ def cohort_start(sim, pool: CohortPool, n_rounds: int,
     stats_all["cohort_active_nodes"] = np.full((n_rounds,), c, np.int32)
 
     if perf_on:
-        exec_seconds = time.perf_counter() - t_run0
         stats_all = sim._attach_perf_stats(stats_all, n_rounds,
-                                           exec_seconds, any_cold)
+                                           sp_all.duration, any_cold)
     report = sim._build_report(stats_all)
     if sim.metrics_enabled:
         stats_all = sim._feed_metrics(dict(stats_all), report, n_rounds)

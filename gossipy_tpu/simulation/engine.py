@@ -490,7 +490,8 @@ class GossipSimulator(SimulationEventSender):
                  chaos: Union[None, dict, ChaosConfig] = None,
                  perf: Union[None, bool, PerfConfig] = None,
                  metrics: Union[None, bool] = None,
-                 cohort=None):
+                 cohort=None,
+                 tracing=None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -688,6 +689,23 @@ class GossipSimulator(SimulationEventSender):
         # ``metrics`` block (schema v7).
         self.metrics_enabled: bool = bool(metrics)
         self._metrics_base = {"rounds": 0, "sent": 0, "failed": 0}
+        # Host-side span tracing (telemetry.tracing): like perf and
+        # metrics, host-side ONLY — tracing on and off compile
+        # byte-identical HLO (gate pair engine/tracing-on) and tracelint's
+        # trace-in-trace rule proves nothing traced can reach the tracer.
+        # None/False = no tracer; True = the process-default tracer
+        # (installed on demand, so engine + service + checkpoint spans
+        # share one timeline); a Tracer instance = explicit sink.
+        # Note: a live tracer adds ONE block_until_ready per start()
+        # segment (the run span must close at execution end, not at async
+        # dispatch) — the same host-sync the perf timing layer does.
+        if tracing is None or tracing is False:
+            self.tracer = None
+        elif tracing is True:
+            from ..telemetry.tracing import ensure_tracer
+            self.tracer = ensure_tracer()
+        else:
+            self.tracer = tracing
         self.chaos: Optional[ChaosConfig] = ChaosConfig.coerce(chaos)
         self.chaos_schedule = None
         self._chaos_edge_form: Optional[str] = None
@@ -2411,86 +2429,130 @@ class GossipSimulator(SimulationEventSender):
         cold = cache_k not in self._jit_cache
 
         import time as _time
+
+        from ..telemetry import tracing as _tracing
+        tr = self.tracer
         args = (state, key, self.data)
         if self.sentinels is not None:
             hc_in = (self._health_carry if self._health_carry is not None
                      else self._health_zero_carry())
             args = args + (hc_in,)
         compile_recorded = False
-        if cold:
-            fn = jax.jit(self._make_run(n_rounds, live),
-                         donate_argnums=(0,) if donate_state else ())
-            if self.perf is not None and self.perf.cost:
-                # AOT detour: compile the SAME program explicitly so
-                # XLA's own cost_analysis/memory_analysis can be banked
-                # at compile time (telemetry.cost.CostReport). Falls back
-                # to plain dispatch-jit if the backend resists AOT.
-                t_c0 = _time.perf_counter()
-                try:
-                    compiled = fn.lower(*args).compile()
-                except Exception as e:
-                    import warnings
-                    warnings.warn("perf cost capture: AOT compile failed "
-                                  f"({e!r}); falling back to dispatch jit "
-                                  "(no CostReport for this program)")
-                    self._jit_cache[cache_k] = fn
+        # The whole segment is one trace "run window" (round_start/rounds
+        # args are what scripts/trace_report.py keys its critical-path and
+        # host_blocked/overlap reduction on).
+        with _tracing.span("engine.start", cat="engine", tracer=tr,
+                           round_start=first_round, rounds=n_rounds,
+                           cold=cold):
+            if cold:
+                fn = jax.jit(self._make_run(n_rounds, live),
+                             donate_argnums=(0,) if donate_state else ())
+                if self.perf is not None and self.perf.cost:
+                    # AOT detour: compile the SAME program explicitly so
+                    # XLA's own cost_analysis/memory_analysis can be banked
+                    # at compile time (telemetry.cost.CostReport). Falls
+                    # back to plain dispatch-jit if the backend resists
+                    # AOT. The span handle is the ONE timing source: it
+                    # feeds both last_compile_seconds and the trace.
+                    sp_c = _tracing.span("engine.compile", cat="engine",
+                                         tracer=tr,
+                                         program=f"start[{n_rounds}r]")
+                    with sp_c:
+                        try:
+                            compiled = fn.lower(*args).compile()
+                        except Exception as e:
+                            compiled, compile_err = None, e
+                    if compiled is None:
+                        import warnings
+                        warnings.warn(
+                            "perf cost capture: AOT compile failed "
+                            f"({compile_err!r}); falling back to dispatch "
+                            "jit (no CostReport for this program)")
+                        self._jit_cache[cache_k] = fn
+                    else:
+                        self.last_compile_seconds = sp_c.duration
+                        compile_recorded = True
+                        self._record_cost(compiled,
+                                          label=f"start[{n_rounds}r]"
+                                                f"{'/live' if live else ''}",
+                                          n_rounds=n_rounds)
+                        self._jit_cache[cache_k] = compiled
                 else:
-                    self.last_compile_seconds = _time.perf_counter() - t_c0
-                    compile_recorded = True
-                    self._record_cost(compiled,
-                                      label=f"start[{n_rounds}r]"
-                                            f"{'/live' if live else ''}",
-                                      n_rounds=n_rounds)
-                    self._jit_cache[cache_k] = compiled
-            else:
-                self._jit_cache[cache_k] = fn
+                    self._jit_cache[cache_k] = fn
 
-        # Live runs get host wall-clock samples per round boundary (the
-        # ordered io_callback already syncs the host there, so the extra
-        # perf_counter is free); non-live runs have no per-round host
-        # boundary and skip timing rather than invent one.
-        self._live_round_times: Optional[list] = [] if live else None
-        t_run0 = _time.perf_counter()
-        if profile_dir is not None:
-            with jax.profiler.trace(profile_dir):
-                out = self._jit_cache[cache_k](*args)
-                jax.block_until_ready(out[0].model.params)
-        else:
-            out = self._jit_cache[cache_k](*args)
-        perf_timing = self.perf is not None and self.perf.timing
-        if perf_timing:
-            # ONE host sync per start() call (not per round): the measured
-            # wall time is this segment's whole-run cost, amortized to
-            # ms/round below. On a cold non-AOT dispatch the measurement
-            # would fold compile time in — flagged via "cold".
-            jax.block_until_ready(out)
-            exec_seconds = _time.perf_counter() - t_run0
-        if self.sentinels is not None:
-            state, self._health_carry, stats = out
-        else:
-            state, stats = out
-        if cold and not compile_recorded:
-            # Wall time of the cold dispatch: tracing + XLA compilation
-            # (execution is async-dispatched and largely excluded, except
-            # under profile_dir where the block_until_ready above folds the
-            # run in). Recorded for the RunManifest. (The perf AOT path
-            # above already recorded the exact compile wall instead.)
-            self.last_compile_seconds = _time.perf_counter() - t_run0
-        if perf_timing:
-            stats = self._attach_perf_stats(dict(stats), n_rounds,
-                                            exec_seconds, cold)
-        # Building the report forces the stats device->host transfer, which
-        # completes only after the program (including its ordered callbacks)
-        # finishes — harvest the live timestamps only after that, or the
-        # async dispatch would race the collection.
-        report = self._build_report(stats)
-        if self.metrics_enabled:
-            stats = self._feed_metrics(dict(stats), report, n_rounds)
-        live_times, self._live_round_times = self._live_round_times, None
-        self.replay_events(first_round, stats, self._metric_keys(),
-                           include_live=live_fallback)
-        if live_times:
-            report.attach_wall_clock(t_run0, live_times)
+            # Live runs get host wall-clock samples per round boundary (the
+            # ordered io_callback already syncs the host there, so the extra
+            # perf_counter is free); non-live runs have no per-round host
+            # boundary and skip timing rather than invent one.
+            self._live_round_times: Optional[list] = [] if live else None
+            t_run0 = _time.perf_counter()
+            perf_timing = self.perf is not None and self.perf.timing
+            # cat="host.wait": the run span is dispatch + completion wait,
+            # not host work — trace_report excludes it from host-busy time
+            # and the bridged device span below accounts the window.
+            sp_run = _tracing.span("engine.run", cat=_tracing.WAIT_CAT,
+                                   tracer=tr)
+            with sp_run:
+                if profile_dir is not None:
+                    with jax.profiler.trace(profile_dir):
+                        out = self._jit_cache[cache_k](*args)
+                        jax.block_until_ready(out[0].model.params)
+                else:
+                    out = self._jit_cache[cache_k](*args)
+                if perf_timing or tr is not None:
+                    # ONE host sync per start() call (not per round): the
+                    # measured wall time is this segment's whole-run cost,
+                    # amortized to ms/round below. On a cold non-AOT
+                    # dispatch the measurement would fold compile time in
+                    # — flagged via "cold". (A live tracer needs the same
+                    # sync: the run span must close at execution end.)
+                    jax.block_until_ready(out)
+            exec_seconds = sp_run.duration
+            if tr is not None:
+                # Bridge device time under the run window: per-phase
+                # attribution when a profiler trace was captured, else the
+                # host-observed execution wait as the device-time proxy.
+                phase_ms = None
+                if profile_dir is not None:
+                    try:
+                        from ..telemetry.cost import phase_times_from_trace
+                        phase_ms = phase_times_from_trace(profile_dir)
+                    except Exception:
+                        phase_ms = None
+                _tracing.attach_device_spans(tr, sp_run.ts_us,
+                                             sp_run.dur_us,
+                                             phase_ms=phase_ms,
+                                             args={"n_rounds": n_rounds})
+            if self.sentinels is not None:
+                state, self._health_carry, stats = out
+            else:
+                state, stats = out
+            if cold and not compile_recorded:
+                # Wall time of the cold dispatch: tracing + XLA compilation
+                # (execution is async-dispatched and largely excluded,
+                # except under profile_dir — or a live tracer — where the
+                # block_until_ready above folds the run in). Recorded for
+                # the RunManifest. (The perf AOT path above already
+                # recorded the exact compile wall instead.)
+                self.last_compile_seconds = _time.perf_counter() - t_run0
+            if perf_timing:
+                stats = self._attach_perf_stats(dict(stats), n_rounds,
+                                                exec_seconds, cold)
+            # Building the report forces the stats device->host transfer,
+            # which completes only after the program (including its ordered
+            # callbacks) finishes — harvest the live timestamps only after
+            # that, or the async dispatch would race the collection.
+            with _tracing.span("engine.report", cat="engine", tracer=tr):
+                report = self._build_report(stats)
+                if self.metrics_enabled:
+                    stats = self._feed_metrics(dict(stats), report,
+                                               n_rounds)
+                live_times, self._live_round_times = \
+                    self._live_round_times, None
+                self.replay_events(first_round, stats, self._metric_keys(),
+                                   include_live=live_fallback)
+            if live_times:
+                report.attach_wall_clock(t_run0, live_times)
         return state, report
 
     def _build_report(self, stats: dict) -> SimulationReport:

@@ -29,6 +29,17 @@ other way, so everything here is importable standalone):
   service scheduler and the engines feed HOST-side only — the tracelint
   ``metrics-in-trace`` rule enforces the same never-in-a-trace contract
   io_callback bodies live under.
+- :mod:`.tracing` — the host-side span tracer (:class:`Tracer`,
+  :func:`span`): Chrome-trace-event timelines (Perfetto-loadable
+  ``trace.json``) of every host segment — cohort sample/gather/compile/
+  run/scatter, engine start, service slices, checkpoint writes — with
+  banked device-phase child spans bridged from :mod:`.cost`, an
+  associative :func:`merge_traces` for multi-process runs, and
+  :func:`trace_report`'s critical-path reduction (per-round
+  ``host_blocked_ms`` / ``device_ms`` / ``overlap_frac``). Host-only by
+  the same contract as metrics: tracing on/off compiles byte-identical
+  HLO, and the tracelint ``trace-in-trace`` rule enforces
+  never-in-a-trace.
 - :mod:`.cost` — :class:`PerfConfig` and the host-side performance
   observability layer (``perf=``): per-compiled-program
   :class:`CostReport` (XLA cost/memory analysis), the analytic
@@ -97,6 +108,18 @@ from .scopes import (
     phases_in_trace_dir,
 )
 from .sink import TelemetryEvent, TelemetrySink, emit_event, get_sink, set_sink
+from .tracing import (
+    TRACE_SCHEMA,
+    SpanHandle,
+    Tracer,
+    attach_device_spans,
+    ensure_tracer,
+    get_tracer,
+    merge_traces,
+    set_tracer,
+    span,
+    trace_report,
+)
 
 __all__ = [
     "FAILURE_CAUSES", "FailureCounts",
@@ -119,4 +142,7 @@ __all__ = [
     "analytic_round_cost", "cost_report_for",
     "differential_phase_attribution", "mfu_estimate", "peak_flops",
     "perf_event_row", "phase_times_from_trace",
+    "Tracer", "SpanHandle", "TRACE_SCHEMA", "span",
+    "get_tracer", "set_tracer", "ensure_tracer",
+    "attach_device_spans", "merge_traces", "trace_report",
 ]

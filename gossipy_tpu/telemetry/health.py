@@ -373,74 +373,81 @@ class FlightRecorder:
         run's own failure."""
         from ..checkpoint import save_checkpoint
         from .sink import get_sink
+        from .tracing import span
 
         name = f"bundle_r{chunk_start_round:06d}_{kind}"
         path = os.path.join(self.out_dir, name)
-        os.makedirs(path, exist_ok=True)
-        save_checkpoint(os.path.join(path, "checkpoint"), state, key=key,
-                        meta={"bundle_version": BUNDLE_VERSION,
-                              "kind": kind,
-                              "round": int(chunk_start_round)})
+        # Bundle capture on the run's trace timeline (process-default
+        # tracer, no-op when tracing is off): a trip that stalls the run
+        # writing its post-mortem shows up as host-blocked time with a name.
+        with span("flight_recorder.write_bundle", cat="checkpoint",
+                  kind=kind, round=int(chunk_start_round)):
+            os.makedirs(path, exist_ok=True)
+            save_checkpoint(os.path.join(path, "checkpoint"), state, key=key,
+                            meta={"bundle_version": BUNDLE_VERSION,
+                                  "kind": kind,
+                                  "round": int(chunk_start_round)})
 
-        detail = dict(detail or {})
-        chaos_cfg = getattr(sim, "chaos", None)
-        if chaos_cfg is not None and "chaos_windows" not in detail:
-            # A chaos-scenario bundle names the fault windows active at
-            # the tripped round AND at the checkpoint round the replay
-            # restores from — a heal-induced trip (the common partition
-            # failure mode) fires just AFTER its window closes, so the
-            # trip round alone can read as fault-free.
-            at = (first_bad_round if first_bad_round is not None
-                  else chunk_start_round)
-            try:
-                detail["chaos_windows"] = chaos_cfg.active_at(at)
-                detail["chaos_windows_at_checkpoint"] = \
-                    chaos_cfg.active_at(chunk_start_round)
-                detail["chaos_horizon"] = int(chaos_cfg.horizon)
-            except Exception:  # verdict context is best-effort
-                pass
-        verdict = {
-            "bundle_version": BUNDLE_VERSION,
-            "kind": kind,
-            "chunk_start_round": int(chunk_start_round),
-            "first_bad_round": (int(first_bad_round)
-                                if first_bad_round is not None else None),
-            "detail": detail,
-            # Performance context of the failure (telemetry.cost): a
-            # dead-run bundle carries the last round's cost, not just
-            # its numerics. Null when the simulator runs without perf=.
-            "perf": _verdict_perf(sim),
-        }
-        with open(os.path.join(path, "verdict.json"), "w") as fh:
-            json.dump(verdict, fh, indent=2)
-            fh.write("\n")
-
-        try:
-            sim.run_manifest(extra={"flight_recorder": {
-                "bundle_version": BUNDLE_VERSION, "kind": kind,
+            detail = dict(detail or {})
+            chaos_cfg = getattr(sim, "chaos", None)
+            if chaos_cfg is not None and "chaos_windows" not in detail:
+                # A chaos-scenario bundle names the fault windows active at
+                # the tripped round AND at the checkpoint round the replay
+                # restores from — a heal-induced trip (the common partition
+                # failure mode) fires just AFTER its window closes, so the
+                # trip round alone can read as fault-free.
+                at = (first_bad_round if first_bad_round is not None
+                      else chunk_start_round)
+                try:
+                    detail["chaos_windows"] = chaos_cfg.active_at(at)
+                    detail["chaos_windows_at_checkpoint"] = \
+                        chaos_cfg.active_at(chunk_start_round)
+                    detail["chaos_horizon"] = int(chaos_cfg.horizon)
+                except Exception:  # verdict context is best-effort
+                    pass
+            verdict = {
+                "bundle_version": BUNDLE_VERSION,
+                "kind": kind,
                 "chunk_start_round": int(chunk_start_round),
-                "trailing_rounds": self.trailing_rounds,
-            }}).save(os.path.join(path, "manifest.json"))
-        except Exception as e:  # manifest is context, not the evidence
-            warnings.warn("flight recorder could not collect the run "
-                          f"manifest: {e!r}")
+                "first_bad_round": (int(first_bad_round)
+                                    if first_bad_round is not None else None),
+                "detail": detail,
+                # Performance context of the failure (telemetry.cost): a
+                # dead-run bundle carries the last round's cost, not just
+                # its numerics. Null when the simulator runs without perf=.
+                "perf": _verdict_perf(sim),
+            }
+            with open(os.path.join(path, "verdict.json"), "w") as fh:
+                json.dump(verdict, fh, indent=2)
+                fh.write("\n")
 
-        sink = get_sink()
-        events = sink.events()
-        round_events = [e for e in events if e.kind == "round"]
-        want = min(self.trailing_rounds, self._rounds_recorded)
-        if len(round_events) < want and sink.dropped_events > 0 \
-                and not self._warned_truncated:
-            self._warned_truncated = True
-            warnings.warn(
-                "flight recorder trailing window truncated: the telemetry "
-                f"sink ring evicted {sink.dropped_events} events "
-                f"(maxlen {sink.maxlen}); the bundle carries "
-                f"{len(round_events)} of the requested {want} trailing "
-                "rounds. Install a larger TelemetrySink to keep more.")
-        with open(os.path.join(path, "events.jsonl"), "w") as fh:
-            for ev in events[-max(self.trailing_rounds, 1) * 2:]:
-                fh.write(json.dumps(ev.to_dict()) + "\n")
+            try:
+                sim.run_manifest(extra={"flight_recorder": {
+                    "bundle_version": BUNDLE_VERSION, "kind": kind,
+                    "chunk_start_round": int(chunk_start_round),
+                    "trailing_rounds": self.trailing_rounds,
+                }}).save(os.path.join(path, "manifest.json"))
+            except Exception as e:  # manifest is context, not the evidence
+                warnings.warn("flight recorder could not collect the run "
+                              f"manifest: {e!r}")
+
+            sink = get_sink()
+            events = sink.events()
+            round_events = [e for e in events if e.kind == "round"]
+            want = min(self.trailing_rounds, self._rounds_recorded)
+            if len(round_events) < want and sink.dropped_events > 0 \
+                    and not self._warned_truncated:
+                self._warned_truncated = True
+                warnings.warn(
+                    "flight recorder trailing window truncated: the "
+                    "telemetry "
+                    f"sink ring evicted {sink.dropped_events} events "
+                    f"(maxlen {sink.maxlen}); the bundle carries "
+                    f"{len(round_events)} of the requested {want} trailing "
+                    "rounds. Install a larger TelemetrySink to keep more.")
+            with open(os.path.join(path, "events.jsonl"), "w") as fh:
+                for ev in events[-max(self.trailing_rounds, 1) * 2:]:
+                    fh.write(json.dumps(ev.to_dict()) + "\n")
 
         self.bundle_path = path
         return path

@@ -156,6 +156,12 @@ def _config_snapshot(sim: Any) -> dict:
         # (telemetry.metrics) — the counters themselves live in the
         # process registry / its exported snapshots, not per run.
         snap["metrics"] = bool(sim.metrics_enabled)
+    if hasattr(sim, "tracer"):
+        # Whether this run recorded a host span timeline
+        # (telemetry.tracing) — the trace itself lives in trace.json /
+        # the Tracer object; summary totals land in the manifest's
+        # top-level ``trace`` block.
+        snap["tracing"] = sim.tracer is not None
     return snap
 
 
@@ -185,6 +191,7 @@ class RunManifest:
     compilation_cache: Optional[dict] = None
     telemetry_sink: Optional[dict] = None
     perf: Optional[dict] = None
+    trace: Optional[dict] = None
     created_at: float = field(default_factory=time.time)
     extra: dict = field(default_factory=dict)
     schema: int = MANIFEST_SCHEMA
@@ -239,6 +246,17 @@ class RunManifest:
                 perf = sim.perf_summary()
             except Exception:
                 perf = None
+        trace = None
+        if getattr(sim, "tracer", None) is not None:
+            # Critical-path totals of the run's host span timeline
+            # (telemetry.tracing.trace_report): host_blocked_ms /
+            # device_ms / overlap_frac over the recorded windows.
+            # Best-effort — a trace problem must never kill the record.
+            try:
+                from .tracing import trace_report
+                trace = trace_report(sim.tracer.snapshot())["totals"]
+            except Exception:
+                trace = None
         config = _config_snapshot(sim)
         if config_overrides:
             config.update(config_overrides)
@@ -253,6 +271,7 @@ class RunManifest:
             compilation_cache=cache_stats,
             telemetry_sink=sink_stats,
             perf=perf,
+            trace=trace,
             extra=dict(extra or {}),
         )
 
@@ -270,6 +289,7 @@ class RunManifest:
             "compilation_cache": self.compilation_cache,
             "telemetry_sink": self.telemetry_sink,
             "perf": self.perf,
+            "trace": self.trace,
         }
         if self.extra:
             out["extra"] = self.extra

@@ -98,8 +98,8 @@ def analyze(entries: list, max_regress: float) -> tuple[str, list]:
     for (metric, backend) in sorted(groups):
         es = sorted(groups[(metric, backend)], key=lambda e: e["order"])
         lines += [f"## {metric} ({backend})", "",
-                  "| source | value | unit | degraded | note |",
-                  "|---|---:|---|---|---|"]
+                  "| source | value | unit | host blk% | degraded | note |",
+                  "|---|---:|---|---:|---|---|"]
         clean = [e for e in es if not _degraded(e["row"])]
         best_prior = None
         if len(clean) >= 2:
@@ -123,9 +123,16 @@ def analyze(entries: list, max_regress: float) -> tuple[str, list]:
                         f"{best_prior} (> {max_regress:.0%} budget)")
                     note += "  **REGRESSION**"
             reason = (row.get("raw") or {}).get("degrade_reason", "")
+            # host_blocked_frac: stamped by scripts/trace_report.py /
+            # bench.py when the run was traced (telemetry.tracing) —
+            # how much of the wall the host spent off the device's
+            # critical path. Blank for untraced rows.
+            hbf = (row.get("raw") or {}).get("host_blocked_frac")
+            hbf_cell = f"{float(hbf) * 100:.1f}" if hbf is not None else ""
             lines.append(
                 f"| {e['source']} | {row['value']} "
                 f"| {row.get('unit', '')} "
+                f"| {hbf_cell} "
                 f"| {'yes — ' + reason if _degraded(row) else ''} "
                 f"| {note} |")
         lines.append("")

@@ -23,8 +23,15 @@ and self-checks the properties the ISSUE-14 acceptance names:
 5. **Coverage accounting** — ``cohort_coverage`` is monotone
    non-decreasing, equals ``touched.mean()`` at the end, and
    ``cohort_active_nodes`` is C on every round.
+6. **Trace accounting** — a traced run (telemetry.tracing) emits a
+   Perfetto-loadable ``trace.json`` whose ``trace_report`` names
+   per-round ``host_blocked_ms`` / ``overlap_frac`` for every round,
+   with the attribution self-consistent: ``host_blocked + device +
+   unaccounted == wall`` exactly, and the untraced gap small
+   (``unaccounted_frac`` < 0.15 — the spans cover the wall).
 
-Artifacts (``--out DIR``): ``cohort_smoke.json`` with every checked sum.
+Artifacts (``--out DIR``): ``cohort_smoke.json`` with every checked sum,
+plus ``trace.json`` / ``trace_report.json`` from the traced run.
 Exit 0 = all checks pass.
 """
 
@@ -164,6 +171,46 @@ def main(argv=None) -> int:
                     jax.tree_util.tree_leaves(pool_d.model)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     record["checkpoint_roundtrip"] = True
+
+    # 6. trace accounting: the same run traced emits a Perfetto-loadable
+    # timeline whose critical-path report accounts for the wall.
+    from gossipy_tpu.telemetry.tracing import Tracer, trace_report
+    sim.tracer = Tracer(process_name="cohort_smoke")
+    sim.start(pool0, n_rounds=ROUNDS, key=key)
+    snap = sim.tracer.snapshot()
+    trace_path = sim.tracer.save(os.path.join(args.out, "trace.json"))
+    sim.tracer = None
+
+    # Chrome trace-event schema: object form, complete events carry
+    # ts/dur/pid/tid (what Perfetto needs to lay out tracks).
+    assert isinstance(snap["traceEvents"], list) and snap["traceEvents"]
+    for ev in snap["traceEvents"]:
+        assert {"ph", "name", "pid", "tid"} <= set(ev), ev
+        if ev["ph"] == "X":
+            assert "ts" in ev and "dur" in ev, ev
+
+    report = trace_report(snap)
+    assert report["n_windows"] >= 1
+    assert len(report["per_round"]) == ROUNDS, report["per_round"]
+    for row in report["per_round"]:
+        assert "host_blocked_ms" in row and "overlap_frac" in row, row
+    tot = report["totals"]
+    # Self-consistency: host_blocked + device + unaccounted == wall is
+    # exact by construction, so a small unaccounted gap IS the claim
+    # that host + device + overlap cover the wall.
+    gap = abs(tot["wall_ms"] - tot["host_blocked_ms"]
+              - tot["device_ms"] - tot["unaccounted_ms"])
+    assert gap < 1.0, (gap, tot)
+    assert tot["unaccounted_frac"] is not None \
+        and tot["unaccounted_frac"] < 0.15, tot
+    with open(os.path.join(args.out, "trace_report.json"), "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    record["trace"] = {"path": os.path.basename(trace_path),
+                       "n_windows": report["n_windows"],
+                       "host_blocked_frac": tot["host_blocked_frac"],
+                       "overlap_frac": tot["overlap_frac"],
+                       "unaccounted_frac": tot["unaccounted_frac"]}
 
     path = os.path.join(args.out, "cohort_smoke.json")
     with open(path, "w") as fh:

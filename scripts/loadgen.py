@@ -17,9 +17,12 @@ its "Done" evidence::
 Stdout carries the ONE row JSON line (bench.py's contract style); the
 human-readable account goes to stderr. Artifacts under ``--out``:
 per-tenant report/manifest/events (the normal service layout),
-``slo_row.json`` (the row), and ``metrics/metrics.json`` +
+``slo_row.json`` (the row), ``metrics/metrics.json`` +
 ``metrics/metrics.prom`` (registry snapshot + OpenMetrics export —
-tail the former live with ``scripts/service_top.py``).
+tail the former live with ``scripts/service_top.py``), and
+``metrics/trace.json`` + ``trace_report.json`` (the host span timeline
+(telemetry.tracing) and its critical-path account; the row carries
+``raw.host_blocked_frac`` from it).
 
 Exit status: 0 only when every tenant that was admitted finished (DONE
 or EVICTED) AND has a recorded time-to-first-round (the acceptance
@@ -82,12 +85,43 @@ def main() -> int:
     else:
         pool = default_spec_pool(n_rounds=args.rounds)
 
+    from gossipy_tpu.telemetry.tracing import Tracer, trace_report
+
     metrics_dir = args.metrics_dir or os.path.join(args.out, "metrics")
+    tracer = Tracer(process_name="loadgen")
     result = run_load(args.out, pool=pool, n_tenants=args.tenants,
                       rate_per_hour=args.rate, seed=args.seed,
                       slice_rounds=args.slice, metrics_dir=metrics_dir,
-                      time_scale=args.time_scale)
+                      time_scale=args.time_scale, tracing=tracer)
     row, queue = result["row"], result["queue"]
+
+    # Final trace + critical-path report: the session already refreshed
+    # metrics_dir/trace.json each poll cycle; save the complete timeline
+    # and fold host-efficiency into the bench row so bench_trend carries
+    # it next to the tenants/hour it explains.
+    os.makedirs(metrics_dir, exist_ok=True)
+    trace_path = tracer.save(os.path.join(metrics_dir, "trace.json"))
+    report = trace_report(tracer.snapshot())
+    report_path = os.path.join(args.out, "trace_report.json")
+    with open(report_path, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    tot = report["totals"]
+    row["raw"]["host_blocked_frac"] = tot["host_blocked_frac"]
+    row["raw"]["trace_overlap_frac"] = tot["overlap_frac"]
+    # Self-consistency of the attribution (host_blocked + device +
+    # unaccounted == wall is exact by construction; the service loop has
+    # untraced admission/build host work, so only the identity — not a
+    # tight unaccounted bound — is asserted here).
+    trace_ok = (report["n_windows"] >= 1
+                and tot["host_blocked_ms"] is not None
+                and tot["overlap_frac"] is not None
+                and abs(tot["wall_ms"] - tot["host_blocked_ms"]
+                        - tot["device_ms"] - tot["unaccounted_ms"]) < 1.0)
+    print(f"[loadgen] trace: {trace_path} -> {report_path} "
+          f"(host_blocked {tot['host_blocked_ms']} ms, "
+          f"overlap {tot['overlap_frac']:.1%}, windows "
+          f"{report['n_windows']})", file=sys.stderr)
     try:
         # Backend stamp (bench.py emit() convention) so bench_trend
         # groups this row with its hardware peers, not across backends.
@@ -129,7 +163,11 @@ def main() -> int:
         print(f"[loadgen] SLO invariant violated: "
               f"missing_ttfr={raw['ttfr_missing']} "
               f"failed={raw['n_failed']}", file=sys.stderr)
-    return 0 if ok else 1
+    if not trace_ok:
+        print(f"[loadgen] trace invariant violated: "
+              f"windows={report['n_windows']} totals={tot}",
+              file=sys.stderr)
+    return 0 if ok and trace_ok else 1
 
 
 if __name__ == "__main__":

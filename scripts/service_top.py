@@ -75,7 +75,40 @@ def _ms(v) -> str:
     return f"{v * 1e3:10.1f}" if v is not None else "         -"
 
 
-def render(snap: dict, path: str) -> str:
+def host_blocked_by_bucket(snap: dict, trace_path: str) -> dict:
+    """Per-bucket host-blocked percentage, bucket digest -> percent.
+
+    Preferred source is the live trace's ``host_blocked%/<bucket>``
+    counter events (the scheduler emits one per slice; the LAST event
+    per bucket is the current value) — they update every slice, not
+    every snapshot. Falls back to the ``service_host_blocked_frac``
+    gauge in the metrics snapshot when no trace.json sits next to
+    metrics.json (tracing off)."""
+    out: dict = {}
+    try:
+        with open(trace_path) as fh:
+            events = json.load(fh).get("traceEvents", [])
+        last_ts: dict = {}
+        for e in events:
+            name = e.get("name", "")
+            if e.get("ph") == "C" and name.startswith("host_blocked%/"):
+                bucket = name.split("/", 1)[1]
+                ts = e.get("ts", 0.0)
+                if ts >= last_ts.get(bucket, -1.0):
+                    last_ts[bucket] = ts
+                    out[bucket] = float(e.get("args", {}).get("value", 0.0))
+        if out:
+            return out
+    except (OSError, json.JSONDecodeError, ValueError):
+        pass
+    for s in _series(snap, "service_host_blocked_frac"):
+        bucket = s["labels"].get("bucket", "")
+        out[bucket] = float(s["value"]) * 100.0
+    return out
+
+
+def render(snap: dict, path: str, host_blk: dict = None) -> str:
+    host_blk = host_blk or {}
     age = time.time() - snap.get("ts", 0.0)
     admitted = _counter_total(snap, "service_tenants_admitted_total")
     by_status = _counter_by(snap, "service_tenants_finished_total",
@@ -108,7 +141,7 @@ def render(snap: dict, path: str) -> str:
                       for s in _series(snap, "service_rounds_total")})
     if buckets:
         lines += ["", "bucket     rounds   round p99 (ms)  "
-                      "compile init/step (s)"]
+                      "compile init/step (s)  host blk%"]
         compile_by = {(s["labels"]["bucket"], s["labels"]["program"]):
                       s["value"]
                       for s in _series(snap, "service_compile_seconds")}
@@ -117,10 +150,13 @@ def render(snap: dict, path: str) -> str:
             p99 = _hist_pct(snap, "service_round_seconds", 0.99, bucket=b)
             ci = compile_by.get((b, "init"))
             cs = compile_by.get((b, "step"))
+            hb = host_blk.get(b)
             lines.append(
                 f"  {b:<9}{int(r):7d} {_ms(p99)}       "
                 f"{ci if ci is not None else 0:6.2f} / "
-                f"{cs if cs is not None else 0:6.2f}")
+                f"{cs if cs is not None else 0:6.2f}"
+                + (f"      {hb:6.1f}" if hb is not None
+                   else "           -"))
 
     shares = [(s["labels"].get("tenant", "?"), s["value"])
               for s in _series(snap, "service_tenant_seconds_total")]
@@ -165,7 +201,9 @@ def main() -> int:
             return f"waiting for {path} ..."
         except json.JSONDecodeError:
             return f"{path}: partial write, retrying ..."
-        return render(snap, path)
+        trace_path = os.path.join(os.path.dirname(path), "trace.json")
+        return render(snap, path,
+                      host_blk=host_blocked_by_bucket(snap, trace_path))
 
     if args.once:
         out = frame()

@@ -213,6 +213,62 @@ class TestMetricsInTrace:
         assert lint({"gossipy_tpu/_mfire.py": src}) == []
 
 
+TRACE_IN_TRACE = '''
+import jax
+from .telemetry.tracing import get_tracer
+
+def body(carry, x):
+    get_tracer().counter_event("rounds", value=1.0)   # host sink!
+    return carry, x
+
+def drive(init):
+    return jax.lax.scan(body, init, None, length=2)
+'''
+
+TRACE_HOST_OK = '''
+import jax
+from .telemetry.tracing import span, get_tracer
+
+def drive(sim, state, key):
+    # Host driver spanning AROUND the jitted call: the whole point.
+    with span("drive.run", tracer=get_tracer()):
+        state, rep = sim.start(state, n_rounds=2, key=key)
+    return state, rep
+
+def step(carry, _):
+    def cb(v):
+        # io_callback body: host-side by contract — tracer calls OK.
+        get_tracer().counter_event("rounds", value=float(v))
+    jax.experimental.io_callback(cb, None, carry, ordered=True)
+    return carry, ()
+
+def traced_drive(init):
+    return jax.lax.scan(step, init, None, length=2)
+'''
+
+
+class TestTraceInTrace:
+    def test_fires_on_tracer_call_in_traced_region(self):
+        fs = lint({"gossipy_tpu/_tfire.py": TRACE_IN_TRACE})
+        assert rules_of(fs) == ["trace-in-trace"]
+        assert all(f.path == "gossipy_tpu/_tfire.py" for f in fs)
+        assert "host-side sink" in fs[0].message
+
+    def test_quiet_in_host_driver_and_io_callback(self):
+        assert lint({"gossipy_tpu/_tquiet.py": TRACE_HOST_OK}) == []
+
+    def test_tree_is_clean(self):
+        # The standing invariant: engine/cohort/scheduler span strictly
+        # host-side (around jitted calls, never inside them), so the
+        # real tree has zero trace-in-trace findings.
+        assert [f for f in lint() if f.rule == "trace-in-trace"] == []
+
+    def test_suppressible_like_any_rule(self):
+        src = TRACE_IN_TRACE.replace(
+            "# host sink!", "# tracelint: disable=trace-in-trace")
+        assert lint({"gossipy_tpu/_tfire.py": src}) == []
+
+
 class TestRegistryRules:
     def test_unregistered_per_round_field_is_flagged(self):
         eng_path = REPO / "gossipy_tpu" / "simulation" / "engine.py"
