@@ -947,6 +947,52 @@ def bench_cohort(nominal_n: int = 1_000_000, rounds: int = 50) -> None:
     print(f"[cohort] nominal {nominal_n}: pool init {pool_s:.2f}s, "
           f"{rounds} rounds at {rate:.1f} r/s, coverage {cov:.4f}",
           file=sys.stderr)
+
+    # Streaming A/B: the same config with a prefetch depth. Timed runs
+    # are untraced (apples to apples with the serial row above); traced
+    # runs from freshly re-inited pools supply the overlap account AND
+    # the bit-identity check the streaming driver promises.
+    from gossipy_tpu.telemetry.tracing import Tracer, trace_report
+    prefetch = int(os.environ.get("GOSSIPY_TPU_COHORT_PREFETCH", "2"))
+
+    def build_cohort_sim(prefetch, tracing=None):
+        return GossipSimulator(handler, NominalTopology(nominal_n),
+                               disp.stacked(), delta=ROUND_LEN,
+                               protocol=AntiEntropyProtocol.PUSH,
+                               sampling_eval=0.01, eval_every=rounds,
+                               history_dtype=HISTORY_DTYPE,
+                               cohort=CohortConfig(size=cohort_size,
+                                                   prefetch=prefetch),
+                               perf=True, tracing=tracing)
+
+    stamp(f"streaming A/B (prefetch {prefetch}): warm + timed")
+    sim_st = build_cohort_sim(prefetch)
+    pool_st = sim_st.init_cohort_pool(key)
+    pool_st, _ = sim_st.start(pool_st, n_rounds=rounds, key=key)
+    t0 = time.perf_counter()
+    pool_st, _ = sim_st.start(pool_st, n_rounds=rounds, key=key)
+    stream_elapsed = time.perf_counter() - t0
+    stream_speedup = elapsed / stream_elapsed
+
+    def traced_frac(prefetch):
+        tr = Tracer(process_name=f"bench.cohort.p{prefetch}")
+        s = build_cohort_sim(prefetch, tracing=tr)
+        p, _ = s.start(s.init_cohort_pool(key), n_rounds=rounds, key=key)
+        tot = trace_report(tr.snapshot())["totals"]
+        return (tot["overlap_frac"] or 0.0, tot["host_blocked_frac"] or 0.0,
+                jax.tree.leaves(p))
+
+    overlap_frac, blocked_frac, leaves_st = traced_frac(prefetch)
+    serial_overlap_frac, serial_blocked_frac, leaves_se = traced_frac(0)
+    bit_identical = all(np.array_equal(np.asarray(a), np.asarray(b))
+                        for a, b in zip(leaves_se, leaves_st))
+    if not bit_identical:
+        raise AssertionError(
+            "streaming cohort run diverged from serial — the prefetch "
+            "pipeline must be bit-identical")
+    print(f"[cohort] streaming prefetch={prefetch}: {stream_speedup:.2f}x "
+          f"vs serial, overlap_frac {overlap_frac:.3f} (serial "
+          f"{serial_overlap_frac:.3f}), bit-identical", file=sys.stderr)
     emit({
         "metric": f"cohort_rounds_per_sec_{nominal_n}nominal",
         "value": round(rate, 2),
@@ -963,9 +1009,17 @@ def bench_cohort(nominal_n: int = 1_000_000, rounds: int = 50) -> None:
             "materialized_prediction_bytes":
                 budget["cohort_materialized_prediction"],
             "pool_coverage_final": round(cov, 6),
+            "stream_prefetch": prefetch,
+            "stream_speedup": round(stream_speedup, 3),
+            "overlap_frac": round(overlap_frac, 4),
+            "host_blocked_frac": round(blocked_frac, 4),
+            "serial_overlap_frac": round(serial_overlap_frac, 4),
+            "serial_host_blocked_frac": round(serial_blocked_frac, 4),
+            "stream_bit_identical": bit_identical,
             "note": "per-round cost is a function of C, not N: the "
                     "materialized engine cannot build this row at all "
-                    "past ~50k nodes on one chip",
+                    "past ~50k nodes on one chip; stream_* fields are "
+                    "the prefetch-pipeline A/B on the same config",
         },
     })
 

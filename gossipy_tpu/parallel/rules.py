@@ -187,6 +187,18 @@ def node_axis_entry(mesh: Mesh, axis_name=None):
     return names[0]
 
 
+def node_axis_size(mesh: Mesh, axis_name=None) -> int:
+    """Total extent of the node axis (product over a combined multi-axis
+    entry) — what a node-leading dimension must divide to shard evenly
+    (the cohort driver's mesh validation reads this)."""
+    entry = node_axis_entry(mesh, axis_name)
+    names = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for a in names:
+        size *= int(mesh.shape[a])
+    return size
+
+
 def model_axis_entry(mesh: Mesh, model_axis=None):
     """The mesh axis used for tensor parallelism, or None. Auto-detects an
     axis named ``"model"`` when not given explicitly."""

@@ -1,7 +1,8 @@
 """Simulation runtime (reference gossipy/simul.py re-designed for TPU)."""
 
-from .cohort import CohortConfig, CohortPool, NominalTopology
-from .engine import GossipSimulator, Mailbox, SimState
+from .cohort import CohortConfig, CohortPool, NominalTopology, PoolStore
+from .engine import (GossipSimulator, Mailbox, MemoryBudgetExceeded,
+                     SimState)
 from .faults import (
     ChaosConfig,
     ChurnProcess,
@@ -47,5 +48,6 @@ __all__ = [
     "ChaosConfig", "OutageEpisode", "PartitionEpisode", "ChurnProcess",
     "FaultSpike", "FaultSchedule", "build_fault_schedule",
     "rounds_to_reconverge",
-    "CohortConfig", "CohortPool", "NominalTopology",
+    "CohortConfig", "CohortPool", "NominalTopology", "PoolStore",
+    "MemoryBudgetExceeded",
 ]

@@ -46,6 +46,10 @@ enforces it.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
+import shutil
+import threading
 from typing import Any, NamedTuple, Optional, Union
 
 import jax
@@ -71,11 +75,25 @@ class CohortConfig:
       cross-device-FL default). Larger values amortize the gather/scatter
       against more in-cohort mixing.
     - ``peer_mode``: ``"resample"`` | ``"induced"`` (module doc).
+    - ``prefetch``: pipeline depth of the streaming driver. 0 (default)
+      runs segments strictly serially; ``k >= 1`` stages (samples +
+      gathers) up to ``k`` future cohorts on a background thread while
+      the current cohort runs on-device, and scatters finished cohorts
+      back asynchronously. The streamed schedule is bit-identical to the
+      serial one — late scatters are overlaid onto staged gathers before
+      launch (see ``cohort_start``).
+    - ``pool_dir``: when set, the resident pool is disk-backed: every
+      :class:`CohortPool` leaf is an ``np.memmap`` over a sparse file in
+      this directory, rows are lazily initialized the first time they
+      are sampled, and nominal N is bounded by storage, not host RAM
+      (the nominal-100M flag). See :class:`PoolStore`.
     """
 
     size: int
     rounds_per_cohort: int = 1
     peer_mode: str = "resample"
+    prefetch: int = 0
+    pool_dir: Optional[str] = None
 
     def __post_init__(self):
         if int(self.size) < 2:
@@ -86,6 +104,13 @@ class CohortConfig:
         if self.peer_mode not in _PEER_MODES:
             raise ValueError(f"unknown peer_mode {self.peer_mode!r}; "
                              f"options: {_PEER_MODES}")
+        if int(self.prefetch) < 0:
+            raise ValueError(
+                f"prefetch must be >= 0, got {self.prefetch}")
+        if self.pool_dir is not None and not isinstance(self.pool_dir,
+                                                        str):
+            raise ValueError("pool_dir must be a directory path string "
+                             f"or None, got {type(self.pool_dir).__name__}")
 
     @staticmethod
     def coerce(value: Union[None, int, dict, "CohortConfig"]
@@ -275,9 +300,30 @@ def init_cohort_pool(sim, key: jax.Array, common_init: bool = False,
     first time it is sampled into a cohort — a documented bias vs the
     materialized engine (docs/scale.md). Pass ``True`` to pay the
     blocked pre-training pass anyway.
+
+    With ``CohortConfig(pool_dir=...)`` no rows are initialized here at
+    all: the returned pool's leaves are sparse-file memmaps
+    (:class:`PoolStore`) and rows materialize lazily, keyed on
+    ``fold_in(key, node_id)``, the first time they are sampled — an
+    existing store directory is re-opened (resume), a missing one is
+    created. Lazy rows are deterministic per (key, node id) but NOT
+    numerically identical to this function's RAM batch init (documented
+    in docs/scale.md).
     """
     n = sim.nominal_n
     cfg = sim.cohort
+    if cfg.pool_dir:
+        if local_train:
+            raise ValueError(
+                "local_train is not supported with pool_dir= (the lazy "
+                "per-row init has no blocked pre-training pass)")
+        if is_pool_store_dir(cfg.pool_dir):
+            store = open_pool_store(sim, cfg.pool_dir)
+        else:
+            store = create_pool_store(sim, key, cfg.pool_dir,
+                                      common_init=common_init)
+        sim._pool_store = store
+        return store.pool()
     block = int(block or max(cfg.size, 65536))
     k_init, k_phase, k_up = jax.random.split(key, 3)
     node_keys = np.asarray(jax.random.split(k_init, n))
@@ -334,8 +380,12 @@ def _host_pool(pool: CohortPool, copy: bool = False) -> CohortPool:
     the segment loop writes in place). ``copy=True`` copies every leaf —
     ``cohort_start`` uses it so the caller's pool keeps its value
     semantics (a FlightRecorder's "last healthy state" reference must
-    not alias the scatter target)."""
+    not alias the scatter target). Memmap leaves (disk-backed pools) are
+    passed through untouched: the file IS the pool, updates are in-place
+    by design."""
     def h(l):
+        if isinstance(l, np.memmap):
+            return l
         a = np.asarray(l)
         return a.copy() if copy or not a.flags.writeable else a
     return jax.tree.map(h, pool)
@@ -346,6 +396,322 @@ def _pool_data_rows(sim) -> int:
     ``i % P``, so a pool of nominal N can ride a data bank of P << N
     shards (at 10M users nobody stacks 10M distinct shards)."""
     return int(sim.data["xtr"].shape[0])
+
+
+# -- disk-backed pools (CohortConfig.pool_dir) -------------------------------
+
+_POOL_MANIFEST = "pool_manifest.json"
+_POOL_FIXED_LEAVES = (("phase.bin", np.int32, 1),
+                      ("node_key.bin", np.uint32, 2),
+                      ("touched.bin", np.bool_, 1),
+                      ("inited.bin", np.uint8, 1))
+
+
+def is_mmap_pool(pool) -> bool:
+    """True when any pool leaf is an ``np.memmap`` (disk-backed pool)."""
+    return any(isinstance(l, np.memmap) for l in jax.tree.leaves(pool))
+
+
+def is_pool_store_dir(path) -> bool:
+    """True when ``path`` is a :class:`PoolStore` directory (live pool or
+    file-copy checkpoint) — the ``load``/``init`` dispatch predicate."""
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, _POOL_MANIFEST))
+
+
+def _write_manifest(path: str, manifest: dict):
+    tmp = os.path.join(path, _POOL_MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(path, _POOL_MANIFEST))
+
+
+def _read_manifest(path: str) -> dict:
+    with open(os.path.join(path, _POOL_MANIFEST)) as f:
+        return json.load(f)
+
+
+def _alloc_sparse(fp: str, nbytes: int):
+    """Create a hole-only file of ``nbytes`` apparent size (ftruncate):
+    zero blocks on disk until a row is actually written."""
+    with open(fp, "wb") as f:
+        f.truncate(int(nbytes))
+
+
+def _sparse_copy(src: str, dst: str, chunk: int = 16 << 20):
+    """Copy a file preserving holes (SEEK_DATA/SEEK_HOLE) so a pool
+    checkpoint costs only the written rows, not the apparent size. Falls
+    back to a dense copy where the fs/OS lacks hole enumeration."""
+    with open(src, "rb") as fi, open(dst, "wb") as fo:
+        size = os.fstat(fi.fileno()).st_size
+        fo.truncate(size)
+        if not hasattr(os, "SEEK_DATA"):
+            shutil.copyfileobj(fi, fo, chunk)
+            return
+        pos = 0
+        while pos < size:
+            try:
+                data = fi.seek(pos, os.SEEK_DATA)
+            except OSError:  # ENXIO: no data past pos — trailing hole
+                break
+            hole = fi.seek(data, os.SEEK_HOLE)
+            fi.seek(data)
+            fo.seek(data)
+            left = hole - data
+            while left > 0:
+                buf = fi.read(min(chunk, left))
+                if not buf:
+                    break
+                fo.write(buf)
+                left -= len(buf)
+            pos = hole
+
+
+def _make_lazy_init(sim, manifest: dict):
+    """The jitted per-row init batch for a :class:`PoolStore`: model,
+    node key and phase for a ``[B]`` block of node ids, each derived by
+    ``fold_in(key, node_id)`` — deterministic per (store key, id) and
+    independent of sampling order, so two runs with different schedules
+    materialize identical rows. Deliberately NOT numerically identical
+    to ``init_cohort_pool``'s RAM batch init (``jax.random.split`` over
+    N is an O(N) materialization; docs/scale.md)."""
+    base = jnp.asarray(np.asarray(manifest["key_material"],
+                                  np.uint32).reshape(-1)[:2])
+    k_init, k_phase = jax.random.split(base, 3)[:2]
+    sync, delta = bool(manifest["sync"]), int(manifest["delta"])
+    common = bool(manifest["common_init"])
+    handler = sim.handler
+
+    def batch(ids):
+        nkeys = jax.vmap(lambda i: jax.random.fold_in(k_init, i))(ids)
+        if common:
+            one = handler.init(k_init)
+            model = jax.tree.map(
+                lambda l: jnp.broadcast_to(l[None],
+                                           (ids.shape[0],) + l.shape),
+                one)
+        else:
+            model = jax.vmap(handler.init)(nkeys)
+        pkeys = jax.vmap(lambda i: jax.random.fold_in(k_phase, i))(ids)
+        if sync:
+            phase = jax.vmap(
+                lambda k: jax.random.randint(k, (), 0, delta,
+                                             dtype=jnp.int32))(pkeys)
+        else:
+            raw = delta + (delta / 10.0) * jax.vmap(
+                lambda k: jax.random.normal(k, ()))(pkeys)
+            phase = jnp.maximum(raw.astype(jnp.int32), 1)
+        return model, nkeys, phase
+
+    return jax.jit(batch)
+
+
+class PoolStore:
+    """A :class:`CohortPool` whose leaves live in sparse files.
+
+    Every leaf is an ``np.memmap`` (mode ``r+``) over a file under
+    ``path``; apparent file size is the full nominal-N footprint but
+    disk blocks materialize only for rows actually written, so nominal
+    100M is bounded by storage, not RAM. Gather/scatter touch only the C
+    sampled rows; ``ensure_rows`` lazily initializes never-seen rows
+    (``_make_lazy_init``) tracked by the ``inited`` bitmask; checkpoints
+    are hole-preserving file copies (``save_pool_store``). Unlike RAM
+    pools, the pool object has in-place update semantics — the returned
+    pool of a run aliases the same files.
+    """
+
+    def __init__(self, sim, path: str, manifest: dict):
+        self.path = os.path.abspath(path)
+        n = int(manifest["nominal_n"])
+        if n != int(sim.nominal_n):
+            raise ValueError(
+                f"pool store {self.path!r} holds nominal_n={n}, "
+                f"simulator expects {sim.nominal_n}")
+        for fld in ("sync", "delta"):
+            if manifest[fld] != getattr(sim, fld):
+                raise ValueError(
+                    f"pool store {self.path!r} was built with "
+                    f"{fld}={manifest[fld]!r}, simulator has "
+                    f"{getattr(sim, fld)!r} (phase init would diverge)")
+        self.manifest = manifest
+        st = _model_shape(sim)
+        flat, treedef = jax.tree_util.tree_flatten(st)
+        specs = manifest["model_leaves"]
+        if len(specs) != len(flat):
+            raise ValueError(
+                f"pool store {self.path!r} holds {len(specs)} model "
+                f"leaves, simulator's model has {len(flat)}")
+        maps = []
+        for spec, l in zip(specs, flat):
+            shape = (n,) + tuple(l.shape)
+            if (tuple(spec["shape"]) != shape
+                    or np.dtype(spec["dtype"]) != np.dtype(l.dtype)):
+                raise ValueError(
+                    f"pool store leaf {spec['file']} is "
+                    f"{spec['shape']}/{spec['dtype']}; simulator expects "
+                    f"{list(shape)}/{np.dtype(l.dtype).name}")
+            maps.append(self._open(spec["file"], np.dtype(l.dtype),
+                                   shape))
+        self.model = jax.tree_util.tree_unflatten(treedef, maps)
+        self.phase = self._open("phase.bin", np.int32, (n,))
+        self.node_key = self._open("node_key.bin", np.uint32, (n, 2))
+        self.touched = self._open("touched.bin", np.bool_, (n,))
+        self.inited = self._open("inited.bin", np.uint8, (n,))
+        self._init_fn = None
+        self._init_block = None
+
+    def _open(self, name: str, dtype, shape) -> np.memmap:
+        return np.memmap(os.path.join(self.path, name), dtype=dtype,
+                         mode="r+", shape=shape)
+
+    def files(self) -> list[str]:
+        return ([s["file"] for s in self.manifest["model_leaves"]]
+                + [name for name, _, _ in _POOL_FIXED_LEAVES])
+
+    def pool(self) -> CohortPool:
+        return CohortPool(model=self.model, phase=self.phase,
+                          node_key=self.node_key, touched=self.touched,
+                          round=np.asarray(int(self.manifest["round"]),
+                                           np.int32))
+
+    def ensure_rows(self, sim, idx: np.ndarray) -> int:
+        """Materialize any not-yet-initialized rows among ``idx`` (lazy
+        init). Runs in fixed-size id blocks (padded by repeating the last
+        id) so the jitted init compiles once per store."""
+        idx = np.asarray(idx)
+        need = idx[self.inited[idx] == 0]
+        if need.size == 0:
+            return 0
+        if self._init_fn is None:
+            self._init_fn = _make_lazy_init(sim, self.manifest)
+            self._init_block = max(int(sim.cohort.size), 256)
+        B = self._init_block
+        model_leaves = jax.tree.leaves(self.model)
+        for lo in range(0, need.size, B):
+            blk = need[lo:lo + B]
+            pad = np.empty(B, np.int32)
+            pad[:blk.size] = blk
+            pad[blk.size:] = blk[-1]
+            model, nkeys, phase = self._init_fn(jnp.asarray(pad))
+            m = blk.size
+            for dst, src in zip(model_leaves, jax.tree.leaves(model)):
+                dst[blk] = np.asarray(src)[:m]
+            self.node_key[blk] = np.asarray(nkeys)[:m]
+            self.phase[blk] = np.asarray(phase)[:m]
+            self.inited[blk] = 1
+        return int(need.size)
+
+    def flush(self):
+        for l in jax.tree.leaves(self.model):
+            l.flush()
+        for l in (self.phase, self.node_key, self.touched, self.inited):
+            l.flush()
+
+    def set_round(self, r: int):
+        self.manifest["round"] = int(r)
+        _write_manifest(self.path, self.manifest)
+
+
+def create_pool_store(sim, key: jax.Array, path: str,
+                      common_init: bool = False) -> PoolStore:
+    """Create a fresh disk-backed pool under ``path`` (sparse files +
+    manifest; no row is initialized — that happens lazily on first
+    sample)."""
+    n = int(sim.nominal_n)
+    if n >= 2 ** 31:
+        raise ValueError(f"pool store node ids are int32; nominal_n={n} "
+                         "exceeds 2**31-1")
+    os.makedirs(path, exist_ok=True)
+    st = _model_shape(sim)
+    model_specs = []
+    for i, l in enumerate(jax.tree.leaves(st)):
+        shape = (n,) + tuple(l.shape)
+        fname = f"model_{i:03d}.bin"
+        _alloc_sparse(os.path.join(path, fname),
+                      int(np.prod(shape)) * np.dtype(l.dtype).itemsize)
+        model_specs.append({"file": fname, "shape": list(shape),
+                            "dtype": np.dtype(l.dtype).name})
+    for fname, dt, width in _POOL_FIXED_LEAVES:
+        _alloc_sparse(os.path.join(path, fname),
+                      n * width * np.dtype(dt).itemsize)
+    manifest = {
+        "schema": 1,
+        "nominal_n": n,
+        "round": 0,
+        "key_material": _seed_material(key),
+        "ckpt_key_material": None,
+        "common_init": bool(common_init),
+        "sync": bool(sim.sync),
+        "delta": int(sim.delta),
+        "cohort": sim.cohort.to_dict(),
+        "model_leaves": model_specs,
+    }
+    _write_manifest(path, manifest)
+    return PoolStore(sim, path, manifest)
+
+
+def open_pool_store(sim, path: str) -> PoolStore:
+    """Open an existing store directory in place (writes go to its
+    files) — the resume path of ``init_cohort_pool(pool_dir=...)``."""
+    return PoolStore(sim, path, _read_manifest(path))
+
+
+def save_pool_store(sim, pool: CohortPool, path: str,
+                    key: Optional[jax.Array] = None) -> str:
+    """Checkpoint a disk-backed pool: flush the memmaps, hole-preserving
+    file copies into ``path``, manifest stamped with the pool's round
+    (and the run key, like ``save_checkpoint``'s sidecar)."""
+    store: Optional[PoolStore] = getattr(sim, "_pool_store", None)
+    if store is None:
+        raise ValueError("no live PoolStore on this simulator; disk-"
+                         "backed pools come from init_cohort_pool/load "
+                         "with CohortConfig(pool_dir=...)")
+    dst = os.path.abspath(path)
+    if dst == store.path:
+        raise ValueError("pool checkpoint dir must differ from the live "
+                         f"pool_dir {store.path!r}")
+    tr = getattr(sim, "tracer", None)
+    with _tracing.span("checkpoint.save", cat="checkpoint", tracer=tr,
+                       path=str(path), pool_store=True):
+        store.flush()
+        os.makedirs(dst, exist_ok=True)
+        for name in store.files():
+            _sparse_copy(os.path.join(store.path, name),
+                         os.path.join(dst, name))
+        manifest = dict(store.manifest)
+        manifest["round"] = int(np.asarray(pool.round))
+        manifest["ckpt_key_material"] = (_seed_material(key)
+                                         if key is not None else None)
+        _write_manifest(dst, manifest)
+    return dst
+
+
+def load_pool_checkpoint(sim, path: str, workdir: Optional[str] = None):
+    """Restore ``(pool, key)`` from a pool-store checkpoint directory.
+
+    The checkpoint files are hole-preserving-copied into ``workdir``
+    (default ``<path>.live``, replaced if present) and the store opened
+    there, so continuing the run never mutates the checkpoint itself.
+    """
+    src = os.path.abspath(path)
+    manifest = _read_manifest(src)
+    dst = os.path.abspath(workdir or (src.rstrip("/\\") + ".live"))
+    if dst != src:
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.makedirs(dst)
+        files = ([s["file"] for s in manifest["model_leaves"]]
+                 + [name for name, _, _ in _POOL_FIXED_LEAVES])
+        for name in files:
+            _sparse_copy(os.path.join(src, name),
+                         os.path.join(dst, name))
+        _write_manifest(dst, manifest)
+    store = PoolStore(sim, dst, dict(manifest))
+    sim._pool_store = store
+    km = manifest.get("ckpt_key_material")
+    restored_key = (jnp.asarray(np.asarray(km, np.uint32))
+                    if km else None)
+    return store.pool(), restored_key
 
 
 # -- cohort sampling ---------------------------------------------------------
@@ -465,12 +831,36 @@ def _make_cohort_run(sim, n_rounds: int):
     return run
 
 
-def _segment_fn(sim, seg_rounds: int):
+def _mesh_fingerprint(mesh):
+    """Hashable mesh identity for the segment-program cache key (same
+    axes + same device ids = same program placement)."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names),
+            tuple(int(d.id) for d in np.ravel(mesh.devices)))
+
+
+def _validate_cohort_mesh(sim, mesh):
+    """Mesh-sharded cohort rounds need C to split evenly across the node
+    axis (the registry's rules put every [C]-leading leaf there)."""
+    from ..parallel import rules as _rules
+    span_sz = _rules.node_axis_size(mesh)
+    c = int(sim.cohort.size)
+    if c % span_sz:
+        raise ValueError(
+            f"cohort size {c} is not divisible by the mesh node-axis "
+            f"extent {span_sz} (axes "
+            f"{_rules.node_axis_entry(mesh)!r}); sharded gather/scatter "
+            "needs equal per-device rows")
+
+
+def _segment_fn(sim, seg_rounds: int, mesh=None):
     """Compile-cached segment program (one per distinct segment length —
     the tail segment of a run whose n_rounds is not a multiple of
     rounds_per_cohort costs one extra compile, like CheckpointManager
-    tail chunks)."""
-    cache_k = ("cohort", seg_rounds, sim._cache_salt())
+    tail chunks — and per mesh placement)."""
+    cache_k = ("cohort", seg_rounds, sim._cache_salt(),
+               _mesh_fingerprint(mesh))
     if cache_k not in sim._jit_cache:
         fn = jax.jit(_make_cohort_run(sim, seg_rounds), donate_argnums=(0,))
         sim._jit_cache[cache_k] = fn
@@ -479,8 +869,35 @@ def _segment_fn(sim, seg_rounds: int):
 
 # -- the driver --------------------------------------------------------------
 
+class _Staged:
+    """One staged cohort: host-gathered rows ready to launch."""
+
+    __slots__ = ("s", "r0", "seg", "idx", "model_rows", "phase_rows",
+                 "nbr", "data_rows", "seen", "ts_us")
+
+
+def _patch_rows(staged: _Staged, out_idx: np.ndarray, out_model: list,
+                out_phase: np.ndarray):
+    """Overlay a finished segment's output rows onto a staged gather:
+    rows of ``staged.idx`` that also appear in ``out_idx`` (both sorted
+    ascending) take the fresher values. Applying outputs oldest-first
+    makes the staged rows exactly what a serial gather would have read —
+    the streaming ≡ serial bit-identity hinges on this."""
+    if out_idx.size == 0 or staged.idx.size == 0:
+        return
+    pos = np.searchsorted(out_idx, staged.idx)
+    pos = np.minimum(pos, out_idx.size - 1)
+    hit = out_idx[pos] == staged.idx
+    if not hit.any():
+        return
+    src = pos[hit]
+    for dst, row in zip(staged.model_rows, out_model):
+        dst[hit] = row[src]
+    staged.phase_rows[hit] = out_phase[src]
+
+
 def cohort_start(sim, pool: CohortPool, n_rounds: int,
-                 key: Optional[jax.Array] = None):
+                 key: Optional[jax.Array] = None, mesh=None):
     """Run ``n_rounds`` active-cohort rounds against the resident pool.
 
     Host-driven segment loop (the actor/learner split): per segment,
@@ -490,6 +907,26 @@ def cohort_start(sim, pool: CohortPool, n_rounds: int,
     Returns ``(pool, SimulationReport)`` — the report carries the
     standard per-round arrays at cohort width plus the
     ``cohort_coverage`` / ``cohort_active_nodes`` accounting rows.
+
+    ``CohortConfig(prefetch=k)`` turns the sequence into a pipeline:
+    a stager thread samples + gathers up to ``k`` future cohorts while
+    the current one runs on-device (XLA releases the GIL during
+    execution), and a flusher thread scatters finished cohorts back
+    asynchronously — the ``cohort.sample/gather/scatter`` spans then
+    overlap the ``cohort.run`` device window on the trace timeline.
+    Bit-identity with the serial schedule is maintained by construction:
+    a staged gather snapshots the not-yet-flushed outputs under a lock
+    and overlays them (``_patch_rows``), and any output that lands
+    after that snapshot is patched in on the main thread right before
+    launch. At most one flush is in flight, so by launch time of
+    segment ``s`` every output ``o < s`` is either in the pool, in the
+    overlay snapshot, or in the launch-time patch set — never lost,
+    never stale.
+
+    ``mesh`` shards every [C]-leading leaf of the active state and data
+    along the mesh's node axis via the ``parallel/rules.py`` registry
+    (``shard_state`` / ``shard_data``) so C grows with the pod; C must
+    divide the node-axis extent.
     """
     if not isinstance(pool, CohortPool):
         raise TypeError(
@@ -502,131 +939,281 @@ def cohort_start(sim, pool: CohortPool, n_rounds: int,
     p_rows = _pool_data_rows(sim)
     first_round = int(np.asarray(pool.round))
     last_round = first_round + n_rounds - 1
+    depth = int(cfg.prefetch)
 
     if sim.has_live_receivers():
         import warnings
         warnings.warn("cohort mode has no in-run host callback path; live "
                       "event receivers fall back to post-run replay")
 
-    pool = _host_pool(pool, copy=True)
+    store: Optional[PoolStore] = getattr(sim, "_pool_store", None)
+    if is_mmap_pool(pool):
+        if store is None:
+            raise ValueError(
+                "mmap-backed pool has no live PoolStore on this "
+                "simulator; obtain the pool from init_cohort_pool/load "
+                "with CohortConfig(pool_dir=...) — the store owns lazy "
+                "row init")
+    else:
+        store = None
+    if mesh is not None:
+        _validate_cohort_mesh(sim, mesh)
+
+    pool = _host_pool(pool, copy=store is None)
+    model_def = jax.tree.structure(pool.model)
     model_leaves = jax.tree.leaves(pool.model)
+    phase_leaf = pool.phase
     touched = pool.touched
+    touched_count = int(np.count_nonzero(touched))
     seg_stats: list[dict] = []
     coverage: list[float] = []
     perf_on = sim.perf is not None and sim.perf.timing
     any_cold = False
     tr = getattr(sim, "tracer", None)
+    induced = cfg.peer_mode == "induced"
+    pernode_keys = [k for k in sim.data if k not in ("x_eval", "y_eval")]
+    host_data = {k: np.asarray(sim.data[k]) for k in pernode_keys}
+    eval_data = {k: v for k, v in sim.data.items()
+                 if k in ("x_eval", "y_eval")}
+    if mesh is not None and eval_data:
+        from .. import parallel as _parallel
+        eval_data = _parallel.shard_data(eval_data, mesh)
+
+    plan: list[tuple[int, int]] = []
+    done = 0
+    while done < n_rounds:
+        seg = min(cfg.rounds_per_cohort, n_rounds - done)
+        plan.append((first_round + done, seg))
+        done += seg
+
+    pend_lock = threading.Lock() if depth > 0 else None
+    pending: dict[int, tuple] = {}
+
+    def stage_job(s: int, r0: int, seg: int) -> _Staged:
+        """Sample + host-gather one cohort (stager thread under
+        prefetch; inline otherwise). Under prefetch the gather snapshots
+        the not-yet-flushed outputs FIRST, raw-gathers the pool rows,
+        then overlays the snapshot oldest-first — any row torn by a
+        concurrent flush necessarily belongs to a snapshotted output and
+        is overwritten whole."""
+        st = _Staged()
+        st.s, st.r0, st.seg = s, r0, seg
+        with _tracing.span("cohort.sample", cat="cohort", tracer=tr,
+                           window=r0) as sp_s:
+            st.idx = sample_cohort(key, r0, n, c)
+        st.ts_us = sp_s.ts_us
+        with _tracing.span("cohort.gather", cat="cohort", tracer=tr,
+                           window=r0):
+            if store is not None:
+                store.ensure_rows(sim, st.idx)
+            if pend_lock is not None:
+                with pend_lock:
+                    snap = [pending[o] for o in sorted(pending)]
+                    st.seen = set(pending)
+            else:
+                snap, st.seen = [], set()
+            st.model_rows = [np.asarray(l)[st.idx] for l in model_leaves]
+            st.phase_rows = np.asarray(phase_leaf)[st.idx]
+            for out in snap:
+                _patch_rows(st, *out)
+            st.nbr = (_local_neighbor_table(sim, st.idx) if induced
+                      else None)
+            st.data_rows = {k: host_data[k][st.idx % p_rows]
+                            for k in pernode_keys}
+        return st
+
+    def launch(st: _Staged):
+        """Build the [C] active state from staged host rows, run the
+        jitted segment program, return host copies of the durable
+        outputs (main thread only — sentinel health carry and stats
+        ordering stay serial)."""
+        nonlocal any_cold
+        r0, seg = st.r0, st.seg
+        fn, cache_k = _segment_fn(sim, seg, mesh)
+        cold = not getattr(fn, "_gossipy_warm", False)
+        with _tracing.span("cohort.stage", cat="cohort", tracer=tr,
+                           window=r0):
+            sub_model = jax.tree.unflatten(
+                model_def, [jnp.asarray(r) for r in st.model_rows])
+            phase_c = jnp.asarray(st.phase_rows)
+            aux = ({"cohort_nbr": jnp.asarray(st.nbr)}
+                   if st.nbr is not None else ())
+            rows = {k: jnp.asarray(v) for k, v in st.data_rows.items()}
+            state = _active_state(sim, sub_model, phase_c, r0, aux)
+            if mesh is not None:
+                from .. import parallel as _parallel
+                state = _parallel.shard_state(state, mesh)
+                rows = _parallel.shard_data(rows, mesh)
+            data_c = dict(eval_data)
+            data_c.update(rows)
+
+            args = (state, key, data_c, jnp.int32(last_round))
+            if sim.sentinels is not None:
+                hc = (sim._health_carry
+                      if sim._health_carry is not None
+                      else sim._health_zero_carry())
+                args = args + (hc,)
+        if cold:
+            any_cold = True
+            if sim.perf is not None and sim.perf.cost:
+                # The start() AOT detour: bank the segment program's
+                # own cost/memory analysis at compile time. The span IS
+                # the compile measurement.
+                sp_c = _tracing.span(
+                    "cohort.compile", cat="cohort", tracer=tr,
+                    program=f"cohort[{seg}r/C{c}]", window=r0)
+                with sp_c:
+                    try:
+                        compiled = fn.lower(*args).compile()
+                    except Exception:
+                        compiled = None
+                if compiled is not None:
+                    sim._record_cost(
+                        compiled,
+                        label=f"cohort_start[{seg}r/C{c}]",
+                        n_rounds=seg)
+                    sim._jit_cache[cache_k] = compiled
+                    fn = compiled
+                    if sim.last_compile_seconds is None:
+                        sim.last_compile_seconds = sp_c.duration
+            try:
+                fn._gossipy_warm = True  # jit wrappers take attrs
+            except Exception:
+                pass
+        # cat="host.wait": dispatch + completion wait, not host work;
+        # the bridged device span below accounts for it.
+        sp_r = _tracing.span("cohort.run", cat=_tracing.WAIT_CAT,
+                             tracer=tr, window=r0)
+        with sp_r:
+            out = fn(*args)
+            if tr is not None:
+                # The run span must close at execution end, not at
+                # async dispatch (the scatter would otherwise absorb
+                # the device wait invisibly).
+                jax.block_until_ready(out)
+        if tr is not None:
+            _tracing.attach_device_spans(
+                tr, sp_r.ts_us, sp_r.dur_us,
+                args={"segment_rounds": seg, "window": r0})
+        if sim.sentinels is not None:
+            final_state, sim._health_carry, stats = out
+        else:
+            final_state, stats = out
+        if cold and sim.last_compile_seconds is None:
+            # No AOT detour: the cold dispatch folded tracing +
+            # compilation — the run span is the best available compile
+            # wall (plus execution when a tracer forced the sync above;
+            # same caveat as engine.start).
+            sim.last_compile_seconds = sp_r.duration
+        with _tracing.span("cohort.fetch", cat="cohort", tracer=tr,
+                           window=r0):
+            seg_stats.append(jax.tree.map(np.asarray, stats))
+            # copy=True is load-bearing: np.asarray here can be a
+            # zero-copy view of the donated input buffers (CPU jax<->np
+            # round-trips alias), whose memory dies with the staged
+            # segment — but `pending`/`recent` must outlive it.
+            out_model = [np.array(l, copy=True)
+                         for l in jax.tree.leaves(final_state.model)]
+            out_phase = np.array(final_state.phase, copy=True)
+        return out_model, out_phase
+
+    def flush_job(st: _Staged, out_model: list, out_phase: np.ndarray):
+        """Scatter one segment's durable outputs back into the pool
+        (flusher thread under prefetch; inline otherwise). The flusher
+        owns coverage accounting — flushes are FIFO, so the incremental
+        count matches the serial schedule exactly."""
+        nonlocal touched_count
+        with _tracing.span("cohort.scatter", cat="cohort", tracer=tr,
+                           window=st.r0):
+            for dst, src in zip(model_leaves, out_model):
+                dst[st.idx] = src
+            phase_leaf[st.idx] = out_phase
+            newly = int(np.count_nonzero(~touched[st.idx]))
+            touched[st.idx] = True
+        touched_count += newly
+        coverage.extend([touched_count / float(n)] * st.seg)
+        if pend_lock is not None:
+            with pend_lock:
+                pending.pop(st.s, None)
+            if tr is not None and st.ts_us is not None:
+                # Streaming windows are emitted post-flush as explicit
+                # complete events [sample start, flush end] — they
+                # overlap in time, which trace_report's window-tag
+                # attribution handles.
+                tr.add_complete(
+                    "cohort.segment", st.ts_us,
+                    tr._now_us() - st.ts_us, cat="cohort",
+                    args={"round_start": st.r0, "rounds": st.seg,
+                          "streaming": True})
 
     # Every host segment is spanned (telemetry.tracing): the span handles
     # are the ONE timing source — perf exec wall reads the outer span,
     # last_compile_seconds reads the compile span — no parallel
     # perf_counter locals to drift from what the trace shows. The
     # per-segment "cohort.segment" span carries the round_start/rounds
-    # window args scripts/trace_report.py reduces on.
+    # window args scripts/trace_report.py reduces on; under prefetch the
+    # inner sample/gather/compile/run/scatter spans carry window=r0 tags
+    # because windows overlap and containment alone cannot attribute.
     sp_all = _tracing.span("cohort.start", cat="cohort", tracer=tr,
-                           total_rounds=n_rounds, cohort_size=c)
+                           total_rounds=n_rounds, cohort_size=c,
+                           prefetch=depth)
     with sp_all:
-        done = 0
-        while done < n_rounds:
-            seg = min(cfg.rounds_per_cohort, n_rounds - done)
-            r0 = first_round + done
-            with _tracing.span("cohort.segment", cat="cohort", tracer=tr,
-                               round_start=r0, rounds=seg):
-                fn, cache_k = _segment_fn(sim, seg)
-                cold = not getattr(fn, "_gossipy_warm", False)
-
-                with _tracing.span("cohort.sample", cat="cohort",
-                                   tracer=tr):
-                    idx = sample_cohort(key, r0, n, c)
-                    jidx = jnp.asarray(idx)
-                with _tracing.span("cohort.gather", cat="cohort",
-                                   tracer=tr):
-                    sub_model = jax.tree.map(
-                        lambda l: jnp.asarray(np.asarray(l)[idx]),
-                        pool.model)
-                    phase_c = jnp.asarray(np.asarray(pool.phase)[idx])
-                    aux = ()
-                    if cfg.peer_mode == "induced":
-                        aux = {"cohort_nbr": jnp.asarray(
-                            _local_neighbor_table(sim, idx))}
-                    data_c = {k: (v if k in ("x_eval", "y_eval")
-                                  else v[jidx % p_rows])
-                              for k, v in sim.data.items()}
-                    state = _active_state(sim, sub_model, phase_c, r0,
-                                          aux)
-
-                args = (state, key, data_c, jnp.int32(last_round))
-                if sim.sentinels is not None:
-                    hc = (sim._health_carry
-                          if sim._health_carry is not None
-                          else sim._health_zero_carry())
-                    args = args + (hc,)
-                if cold:
-                    any_cold = True
-                    if sim.perf is not None and sim.perf.cost:
-                        # The start() AOT detour: bank the segment
-                        # program's own cost/memory analysis at compile
-                        # time. The span IS the compile measurement.
-                        sp_c = _tracing.span(
-                            "cohort.compile", cat="cohort", tracer=tr,
-                            program=f"cohort[{seg}r/C{c}]")
-                        with sp_c:
-                            try:
-                                compiled = fn.lower(*args).compile()
-                            except Exception:
-                                compiled = None
-                        if compiled is not None:
-                            sim._record_cost(
-                                compiled,
-                                label=f"cohort_start[{seg}r/C{c}]",
-                                n_rounds=seg)
-                            sim._jit_cache[cache_k] = compiled
-                            fn = compiled
-                            if sim.last_compile_seconds is None:
-                                sim.last_compile_seconds = sp_c.duration
-                    try:
-                        fn._gossipy_warm = True  # jit wrappers take attrs
-                    except Exception:
-                        pass
-                # cat="host.wait": dispatch + completion wait, not host
-                # work; the bridged device span below accounts for it.
-                sp_r = _tracing.span("cohort.run", cat=_tracing.WAIT_CAT,
-                                     tracer=tr)
-                with sp_r:
-                    out = fn(*args)
-                    if tr is not None:
-                        # The run span must close at execution end, not
-                        # at async dispatch (the scatter below would
-                        # otherwise absorb the device wait invisibly).
-                        jax.block_until_ready(out)
-                if tr is not None:
-                    _tracing.attach_device_spans(
-                        tr, sp_r.ts_us, sp_r.dur_us,
-                        args={"segment_rounds": seg})
-                if sim.sentinels is not None:
-                    final_state, sim._health_carry, stats = out
-                else:
-                    final_state, stats = out
-                if cold and sim.last_compile_seconds is None:
-                    # No AOT detour: the cold dispatch folded tracing +
-                    # compilation — the run span is the best available
-                    # compile wall (plus execution when a tracer forced
-                    # the sync above; same caveat as engine.start).
-                    sim.last_compile_seconds = sp_r.duration
-
-                # Scatter the durable state back into the pool (host).
-                with _tracing.span("cohort.scatter", cat="cohort",
-                                   tracer=tr):
-                    for dst, src in zip(model_leaves,
-                                        jax.tree.leaves(
-                                            final_state.model)):
-                        dst[idx] = np.asarray(src)
-                    pool.phase[idx] = np.asarray(final_state.phase)
-                    touched[idx] = True
-                cov = float(touched.mean())
-                coverage.extend([cov] * seg)
-                seg_stats.append(jax.tree.map(np.asarray, stats))
-            done += seg
+        if depth == 0:
+            for s, (r0, seg) in enumerate(plan):
+                with _tracing.span("cohort.segment", cat="cohort",
+                                   tracer=tr, round_start=r0,
+                                   rounds=seg):
+                    st = stage_job(s, r0, seg)
+                    out_model, out_phase = launch(st)
+                    flush_job(st, out_model, out_phase)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            stager = ThreadPoolExecutor(
+                1, thread_name_prefix="cohort-stage")
+            flusher = ThreadPoolExecutor(
+                1, thread_name_prefix="cohort-flush")
+            stage_futs: dict[int, Any] = {}
+            recent: dict[int, tuple] = {}
+            flush_fut = None
+            try:
+                for s, (r0, seg) in enumerate(plan):
+                    for j in range(s, min(s + depth + 1, len(plan))):
+                        if j not in stage_futs:
+                            stage_futs[j] = stager.submit(
+                                stage_job, j, *plan[j])
+                    st = stage_futs.pop(s).result()
+                    # Launch-time patch: outputs that landed after the
+                    # staged gather's snapshot (retained in `recent` for
+                    # the last depth+1 segments) — ascending order, so
+                    # the newest write wins, exactly like serial. Only
+                    # outputs NEWER than everything the snapshot saw
+                    # qualify: an output absent from `seen` but older
+                    # than max(seen) was flushed before the snapshot
+                    # (flushes are FIFO), so the raw gather already holds
+                    # it — re-patching it here would clobber a newer
+                    # pending output's overlay on shared rows.
+                    cut = max(st.seen) if st.seen else -1
+                    for o in sorted(recent):
+                        if o > cut:
+                            _patch_rows(st, *recent[o])
+                    out_model, out_phase = launch(st)
+                    if flush_fut is not None:
+                        # Bound pending flushes to <= 1: by the time
+                        # stage_job(s) was submitted, every output
+                        # older than s - depth - 1 had been flushed.
+                        flush_fut.result()
+                    with pend_lock:
+                        pending[s] = (st.idx, out_model, out_phase)
+                    recent[s] = (st.idx, out_model, out_phase)
+                    for o in [o for o in recent if o < s - depth]:
+                        del recent[o]
+                    flush_fut = flusher.submit(
+                        flush_job, st, out_model, out_phase)
+                if flush_fut is not None:
+                    flush_fut.result()
+            finally:
+                stager.shutdown(wait=True)
+                flusher.shutdown(wait=True)
 
     stats_all: dict = {}
     for k in seg_stats[0]:
@@ -643,6 +1230,11 @@ def cohort_start(sim, pool: CohortPool, n_rounds: int,
     sim.replay_events(first_round, stats_all, sim._metric_keys(),
                       include_live=True)
 
+    if store is not None:
+        # Live disk-backed pools persist their round counter so a
+        # re-opened pool_dir resumes where the run left off.
+        store.flush()
+        store.set_round(first_round + n_rounds)
     new_pool = CohortPool(model=pool.model, phase=pool.phase,
                           node_key=pool.node_key, touched=touched,
                           round=np.asarray(first_round + n_rounds,

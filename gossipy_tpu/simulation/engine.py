@@ -41,6 +41,7 @@ Fidelity notes (documented divergences, SURVEY.md §7c):
 
 from __future__ import annotations
 
+import os
 from typing import Any, NamedTuple, Optional, Union
 
 import jax
@@ -280,6 +281,34 @@ def _rank_within_group(key_arr: jax.Array) -> jax.Array:
     return jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
 
 
+class MemoryBudgetExceeded(RuntimeError):
+    """Predicted device-memory footprint exceeds the accelerator budget.
+
+    Raised by :meth:`GossipSimulator.check_memory_budget` BEFORE any
+    compile/launch is paid, so a run that would die with an opaque
+    accelerator rc=1 (the 50k materialized ladder crash,
+    ``degrade_reason: accel_run_rc_1`` in BASELINE.md) instead names the
+    predicted bytes, the limit, and the dominant budget term. Carries
+    ``predicted_bytes``, ``limit_bytes``, ``dominant_term`` and the full
+    ``budget`` dict for forensics.
+    """
+
+    def __init__(self, predicted_bytes: int, limit_bytes: int,
+                 dominant_term: str, budget: dict):
+        self.predicted_bytes = int(predicted_bytes)
+        self.limit_bytes = int(limit_bytes)
+        self.dominant_term = dominant_term
+        self.budget = budget
+        super().__init__(
+            f"memory budget refused: predicted "
+            f"{predicted_bytes / 2**30:.2f} GB exceeds the "
+            f"{limit_bytes / 2**30:.2f} GB limit; dominant term "
+            f"{dominant_term} = "
+            f"{(budget.get(dominant_term) or 0) / 2**30:.2f} GB — "
+            "shrink N/history depth, or switch to cohort mode "
+            "(simulation/cohort.py) where per-round cost is C-shaped")
+
+
 class GossipSimulator(SimulationEventSender):
     """Vanilla gossip simulator (reference GossipSimulator, simul.py:273-503).
 
@@ -512,6 +541,9 @@ class GossipSimulator(SimulationEventSender):
         self.nominal_n = int(topology.num_nodes)
         from .cohort import CohortConfig
         self.cohort = CohortConfig.coerce(cohort)
+        # Live disk-backed pool store (CohortConfig.pool_dir), owned by
+        # init_cohort_pool/load — None for RAM pools and non-cohort runs.
+        self._pool_store = None
         if self.cohort is not None:
             if chaos is not None:
                 raise ValueError(
@@ -1020,7 +1052,47 @@ class GossipSimulator(SimulationEventSender):
                 int(n_scaled * (self.nominal_n / max(self.n_nodes, 1)))
                 + (out.get("data_bytes") or 0)
                 + (out.get("eval_peak_bytes") or 0))
+            out["cohort_pool_disk_backed"] = bool(self.cohort.pool_dir)
         return out
+
+    def check_memory_budget(self, limit_bytes: Optional[int] = None
+                            ) -> dict:
+        """Predict-and-refuse: raise :class:`MemoryBudgetExceeded` when
+        :meth:`memory_budget`'s device total will not fit, BEFORE any
+        compile or launch is paid. Returns the budget dict when it fits
+        (or when no limit is discoverable).
+
+        Limit resolution, first hit wins: the explicit ``limit_bytes``
+        argument; the ``GOSSIPY_TPU_MEMORY_LIMIT`` env var (bytes — the
+        CI/test hook); the default device's own
+        ``memory_stats()["bytes_limit"]`` (TPU/GPU; CPU backends report
+        none and the check passes). The budget total is a floor (no XLA
+        workspace/fusion temporaries), so refusal is conservative:
+        anything refused here was certainly going to die louder later.
+        """
+        budget = self.memory_budget()
+        limit = limit_bytes
+        if limit is None:
+            env = os.environ.get("GOSSIPY_TPU_MEMORY_LIMIT")
+            if env:
+                limit = int(float(env))
+        if limit is None:
+            try:
+                stats = jax.devices()[0].memory_stats()
+                limit = (stats or {}).get("bytes_limit")
+            except Exception:
+                limit = None
+        if limit is None:
+            return budget
+        predicted = int(budget["total_bytes"])
+        if predicted > int(limit):
+            terms = {k: v for k, v in budget.items()
+                     if k.endswith("_bytes") and k != "total_bytes"
+                     and v is not None}
+            dominant = max(terms, key=terms.get) if terms else "total_bytes"
+            raise MemoryBudgetExceeded(predicted, int(limit), dominant,
+                                       budget)
+        return budget
 
     def _local_data(self):
         return (self.data["xtr"], self.data["ytr"], self.data["mtr"])
@@ -2277,7 +2349,16 @@ class GossipSimulator(SimulationEventSender):
              key: Optional[jax.Array] = None) -> str:
         """Checkpoint a simulation state (reference ``GossipSimulator.save``
         dill-dumps the whole simulator + CACHE; here the state pytree IS the
-        whole world — see gossipy_tpu/checkpoint.py)."""
+        whole world — see gossipy_tpu/checkpoint.py).
+
+        Disk-backed cohort pools (``CohortConfig.pool_dir``) checkpoint
+        as hole-preserving file copies of the store directory
+        (:func:`~gossipy_tpu.simulation.cohort.save_pool_store`) —
+        O(written rows), never O(nominal N)."""
+        if self.cohort is not None:
+            from .cohort import is_mmap_pool, save_pool_store
+            if is_mmap_pool(state):
+                return save_pool_store(self, state, path, key=key)
         from ..checkpoint import save_checkpoint
         return save_checkpoint(path, state, key=key)
 
@@ -2295,7 +2376,12 @@ class GossipSimulator(SimulationEventSender):
         pool — restores stay O(pool bytes), never O(init compute)."""
         from ..checkpoint import restore_checkpoint
         if self.cohort is not None:
-            from .cohort import pool_template
+            from .cohort import (is_pool_store_dir, load_pool_checkpoint,
+                                 pool_template)
+            if is_pool_store_dir(path):
+                # Disk-backed pool checkpoint: file copies into a work
+                # directory, memmaps opened there — never materialized.
+                return load_pool_checkpoint(self, path)
             return restore_checkpoint(path, pool_template(self), key)
         template = self.init_nodes(jax.random.PRNGKey(0), local_train=False)
         if mesh is not None:
@@ -2386,7 +2472,8 @@ class GossipSimulator(SimulationEventSender):
     def start(self, state: SimState, n_rounds: int = 100,
               key: Optional[jax.Array] = None,
               profile_dir: Optional[str] = None,
-              donate_state: bool = True) -> tuple[SimState, SimulationReport]:
+              donate_state: bool = True,
+              mesh=None) -> tuple[SimState, SimulationReport]:
         """Run ``n_rounds`` rounds (reference simul.py:366-458) as one
         ``lax.scan``; returns the final state and a report.
 
@@ -2406,11 +2493,18 @@ class GossipSimulator(SimulationEventSender):
         simulation.cohort.CohortPool` and the call is the host-driven
         gather -> [C]-round -> scatter segment loop (``profile_dir`` /
         ``donate_state`` do not apply there: segments donate their own
-        freshly-built state).
+        freshly-built state). ``mesh`` (cohort mode only) shards the
+        [C]-wide active state and data across the mesh's node axis via
+        the ``parallel/rules.py`` registry.
         """
         if self.cohort is not None:
             from .cohort import cohort_start
-            return cohort_start(self, state, n_rounds, key)
+            return cohort_start(self, state, n_rounds, key, mesh=mesh)
+        if mesh is not None:
+            raise ValueError(
+                "start(mesh=) is the cohort-mode sharded-round path; "
+                "for materialized populations place the state up front "
+                "with parallel.shard_state(state, mesh)")
         if key is None:
             key = jax.random.PRNGKey(42)
 

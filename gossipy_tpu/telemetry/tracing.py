@@ -488,30 +488,76 @@ def trace_report(snapshot: dict) -> dict:
 
     Window totals are distributed evenly over the window's rounds into
     ``per_round`` rows. ``critical_path`` ranks span names by their
-    non-overlapped (critical-path) milliseconds across all windows."""
+    non-overlapped (critical-path) milliseconds across all windows.
+
+    Attribution is robust to windows that OVERLAP in time (the streaming
+    cohort pipeline's ``cohort.segment`` windows span [sample start,
+    flush end] of concurrent segments): a span carrying a
+    ``window=<round_start>`` arg is attributed to the window with that
+    ``round_start`` (nearest in time among duplicates); an untagged span
+    falls back to its TIGHTEST containing window (exactly one — the old
+    convention double-counted spans under nested windows). Overlap and
+    blocked time are computed against the pid-wide device union, not
+    just the window's own device spans — a gather for segment t+1 hidden
+    behind segment t's run is exactly the overlap streaming is buying.
+    """
     events = snapshot.get("traceEvents", [])
     spans = [e for e in events if e.get("ph") == "X"]
-    windows = [e for e in spans
-               if all(k in e.get("args", {}) for k in _WINDOW_ARGS)]
+
+    def _is_window(e):
+        return all(k in e.get("args", {}) for k in _WINDOW_ARGS)
+
+    windows = sorted((e for e in spans if _is_window(e)), key=_event_key)
+    others = [e for e in spans if not _is_window(e)]
+    # pid-wide device union: the overlap/blocked context. Inside one
+    # window host work may hide behind ANOTHER window's device time.
+    dev_all: dict = {}
+    for e in others:
+        if e.get("cat") == "device":
+            dev_all.setdefault(e.get("pid"), []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    dev_all = {pid: _union(v) for pid, v in dev_all.items()}
+
+    def _dist(w, e):
+        return max(w["ts"] - (e["ts"] + e["dur"]),
+                   e["ts"] - (w["ts"] + w["dur"]), 0.0)
+
+    assigned: list[list] = [[] for _ in windows]
+    widx = {id(w): i for i, w in enumerate(windows)}
+    for e in others:
+        pid = e.get("pid")
+        tag = (e.get("args") or {}).get("window")
+        if tag is not None:
+            cands = [w for w in windows if w.get("pid") == pid
+                     and int(w["args"]["round_start"]) == int(tag)]
+            if cands:
+                w = min(cands, key=lambda w: _dist(w, e))
+                assigned[widx[id(w)]].append(e)
+                continue
+        cands = [w for w in windows if w.get("pid") == pid
+                 and e["ts"] >= w["ts"]
+                 and e["ts"] + e["dur"] <= w["ts"] + w["dur"]]
+        if cands:
+            w = min(cands, key=lambda w: w["dur"])
+            assigned[widx[id(w)]].append(e)
+
     per_round: list[dict] = []
     window_rows: list[dict] = []
     crit: dict[str, float] = {}
     tot = {"wall_ms": 0.0, "host_busy_ms": 0.0, "host_blocked_ms": 0.0,
            "device_ms": 0.0, "overlap_ms": 0.0, "unaccounted_ms": 0.0}
 
-    for w in sorted(windows, key=_event_key):
+    for w, inner in zip(windows, assigned):
         w0, w1 = w["ts"], w["ts"] + w["dur"]
-        inner = [e for e in spans
-                 if e is not w and e.get("pid") == w.get("pid")
-                 and e["ts"] >= w0 and e["ts"] + e["dur"] <= w1]
         dev = _union([(e["ts"], e["ts"] + e["dur"]) for e in inner
                       if e.get("cat") == "device"])
+        dev_ctx = dev_all.get(w.get("pid")) or dev
         host_spans = [e for e in inner
                       if e.get("cat") not in ("device", WAIT_CAT)]
         host = _union([(e["ts"], e["ts"] + e["dur"])
                        for e in host_spans])
-        overlap = _intersect(host, dev)
-        blocked = _subtract(host, dev)
+        overlap = _intersect(host, dev_ctx)
+        blocked = _subtract(host, dev_ctx)
         wall_ms = (w1 - w0) / 1e3
         device_ms = _total(dev) / 1e3
         host_busy_ms = _total(host) / 1e3
@@ -544,9 +590,10 @@ def trace_report(snapshot: dict) -> dict:
                 "overlap_frac": row["overlap_frac"],
             })
         # Critical-path attribution: each host span's non-device-
-        # overlapped time, plus the device time itself.
+        # overlapped time (vs the pid-wide device union), plus the
+        # device time itself.
         for e in host_spans:
-            iv = _subtract([(e["ts"], e["ts"] + e["dur"])], dev)
+            iv = _subtract([(e["ts"], e["ts"] + e["dur"])], dev_ctx)
             crit[e["name"]] = crit.get(e["name"], 0.0) + _total(iv) / 1e3
         for e in inner:
             if e.get("cat") == "device":

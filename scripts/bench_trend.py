@@ -98,8 +98,9 @@ def analyze(entries: list, max_regress: float) -> tuple[str, list]:
     for (metric, backend) in sorted(groups):
         es = sorted(groups[(metric, backend)], key=lambda e: e["order"])
         lines += [f"## {metric} ({backend})", "",
-                  "| source | value | unit | host blk% | degraded | note |",
-                  "|---|---:|---|---:|---|---|"]
+                  "| source | value | unit | host blk% | stream× "
+                  "| degraded | note |",
+                  "|---|---:|---|---:|---:|---|---|"]
         clean = [e for e in es if not _degraded(e["row"])]
         best_prior = None
         if len(clean) >= 2:
@@ -129,10 +130,16 @@ def analyze(entries: list, max_regress: float) -> tuple[str, list]:
             # critical path. Blank for untraced rows.
             hbf = (row.get("raw") or {}).get("host_blocked_frac")
             hbf_cell = f"{float(hbf) * 100:.1f}" if hbf is not None else ""
+            # stream_speedup: bench.py --cohort's prefetch-pipeline A/B
+            # (streaming wall vs serial wall, same config). Blank for
+            # rows without a streaming variant.
+            spd = (row.get("raw") or {}).get("stream_speedup")
+            spd_cell = f"{float(spd):.2f}" if spd is not None else ""
             lines.append(
                 f"| {e['source']} | {row['value']} "
                 f"| {row.get('unit', '')} "
                 f"| {hbf_cell} "
+                f"| {spd_cell} "
                 f"| {'yes — ' + reason if _degraded(row) else ''} "
                 f"| {note} |")
         lines.append("")

@@ -29,9 +29,21 @@ and self-checks the properties the ISSUE-14 acceptance names:
    with the attribution self-consistent: ``host_blocked + device +
    unaccounted == wall`` exactly, and the untraced gap small
    (``unaccounted_frac`` < 0.15 — the spans cover the wall).
+7. **Streaming A/B** — a heavier config (C = 768, rounds_per_cohort = 2,
+   device-bound segments) run serial then with ``prefetch=8``, both
+   traced: the streamed pool is BIT-IDENTICAL to the serial one, the
+   streaming trace's ``overlap_frac`` exceeds 0.3 while the serial one
+   stays ~0, and both trace reports land in the artifacts
+   (``trace_report_serial.json`` / ``trace_report_stream.json``).
+8. **Nominal-100M disk pool** — ``CohortConfig(pool_dir=...)`` at
+   nominal N = 100,000,000: a short streamed run completes with the
+   sparse pool files allocating < 1 GB on disk (logical size ~7 GB)
+   and peak RSS far below the 2.9 GB a dense float32 pool of that
+   population would need — the pool was never materialized in RAM.
 
 Artifacts (``--out DIR``): ``cohort_smoke.json`` with every checked sum,
-plus ``trace.json`` / ``trace_report.json`` from the traced run.
+plus ``trace.json`` / ``trace_report.json`` from the traced run and the
+serial/stream A/B trace reports.
 Exit 0 = all checks pass.
 """
 
@@ -211,6 +223,130 @@ def main(argv=None) -> int:
                        "host_blocked_frac": tot["host_blocked_frac"],
                        "overlap_frac": tot["overlap_frac"],
                        "unaccounted_frac": tot["unaccounted_frac"]}
+
+    # 7. streaming A/B: bit-identity + overlap. The tiny config above is
+    # dispatch-bound (sub-ms host work per segment), so the A/B runs a
+    # heavier, device-bound shape where the pipeline has something to
+    # hide: C=768 nodes x 2 rounds/cohort segments, [C, 32, 256] data
+    # gathers, prefetch deep enough that every gather queues under a
+    # long-running segment.
+    def build_ab(prefetch, tracing=None):
+        import optax
+
+        from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode
+        from gossipy_tpu.data import ClassificationDataHandler, \
+            DataDispatcher
+        from gossipy_tpu.handlers import SGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import CohortConfig, GossipSimulator, \
+            NominalTopology
+
+        d, c = 256, 768
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=d)
+        X = rng.normal(size=(4 * c * 32, d)).astype(np.float32)
+        y = (X @ w > 0).astype(np.int64)
+        disp = DataDispatcher(
+            ClassificationDataHandler(X, y, test_size=0.1),
+            n=4 * c, eval_on_user=False)
+        h = SGDHandler(model=LogisticRegression(d, 2),
+                       loss=losses.cross_entropy,
+                       optimizer=optax.sgd(0.1), local_epochs=3,
+                       batch_size=8, n_classes=2, input_shape=(d,),
+                       create_model_mode=CreateModelMode.MERGE_UPDATE)
+        return GossipSimulator(
+            h, NominalTopology(100_000), disp.stacked(), delta=20,
+            protocol=AntiEntropyProtocol.PUSH, sampling_eval=0.01,
+            eval_every=10_000,
+            cohort=CohortConfig(size=c, rounds_per_cohort=2,
+                                prefetch=prefetch),
+            tracing=tracing)
+
+    from gossipy_tpu.telemetry.tracing import Tracer as _Tracer
+    ab_rounds = 24
+    ab_fracs = {}
+    ab_leaves = {}
+    for tag, pf in (("serial", 0), ("stream", 8)):
+        tr_ab = _Tracer(process_name=f"cohort_smoke.{tag}")
+        sim_ab = build_ab(pf, tracing=tr_ab)
+        p_ab, _ = sim_ab.start(sim_ab.init_cohort_pool(key),
+                               n_rounds=ab_rounds, key=key)
+        rep_ab = trace_report(tr_ab.snapshot())
+        with open(os.path.join(args.out,
+                               f"trace_report_{tag}.json"), "w") as fh:
+            json.dump(rep_ab, fh, indent=2)
+            fh.write("\n")
+        ab_fracs[tag] = rep_ab["totals"]["overlap_frac"] or 0.0
+        ab_leaves[tag] = [np.asarray(x)
+                          for x in jax.tree_util.tree_leaves(p_ab)]
+    assert len(ab_leaves["serial"]) == len(ab_leaves["stream"])
+    for a, b in zip(ab_leaves["serial"], ab_leaves["stream"]):
+        np.testing.assert_array_equal(a, b)
+    assert ab_fracs["stream"] > 0.3, (
+        f"streaming overlap_frac {ab_fracs['stream']} <= 0.3 — the "
+        "prefetch pipeline is not hiding host work behind compute")
+    record["stream_ab"] = {"rounds": ab_rounds, "prefetch": 8,
+                           "bit_identical": True,
+                           "overlap_frac_serial": ab_fracs["serial"],
+                           "overlap_frac_stream": ab_fracs["stream"]}
+
+    # 8. nominal-100M disk-backed pool: a short streamed run over a
+    # sparse mmap pool — bounded RAM and bounded disk, at a population
+    # three orders past what a dense host pool could hold.
+    import resource
+    import shutil
+    import tempfile
+
+    tmp_root = tempfile.mkdtemp(prefix="cohort_pool_")
+    try:
+        import optax
+
+        from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode
+        from gossipy_tpu.data import ClassificationDataHandler, \
+            DataDispatcher
+        from gossipy_tpu.handlers import SGDHandler, losses
+        from gossipy_tpu.models import LogisticRegression
+        from gossipy_tpu.simulation import CohortConfig, GossipSimulator, \
+            NominalTopology
+
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=D)
+        X = rng.normal(size=(128 * 8, D)).astype(np.float32)
+        y = (X @ w > 0).astype(np.int64)
+        disp = DataDispatcher(
+            ClassificationDataHandler(X, y, test_size=0.25),
+            n=128, eval_on_user=False)
+        h = SGDHandler(model=LogisticRegression(D, 2),
+                       loss=losses.cross_entropy,
+                       optimizer=optax.sgd(0.1), local_epochs=1,
+                       batch_size=8, n_classes=2, input_shape=(D,),
+                       create_model_mode=CreateModelMode.MERGE_UPDATE)
+        pool_dir = os.path.join(tmp_root, "pool100m")
+        sim_mm = GossipSimulator(
+            h, NominalTopology(100_000_000), disp.stacked(), delta=20,
+            protocol=AntiEntropyProtocol.PUSH, sampling_eval=0.01,
+            eval_every=10_000,
+            cohort=CohortConfig(size=32, prefetch=2, pool_dir=pool_dir))
+        assert sim_mm.memory_budget()["cohort_pool_disk_backed"]
+        p_mm, _ = sim_mm.start(sim_mm.init_cohort_pool(key), n_rounds=4,
+                               key=key)
+        assert int(np.asarray(p_mm.round)) == 4
+        logical = alloc = 0
+        for f in os.listdir(pool_dir):
+            st = os.stat(os.path.join(pool_dir, f))
+            logical += st.st_size
+            alloc += st.st_blocks * 512
+        rss_gb = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1e6
+        assert logical > 2e9, logical    # nominal-sized address space...
+        assert alloc < 1e9, alloc        # ...never materialized on disk
+        assert rss_gb < 8, rss_gb        # ...nor in RAM
+        record["pool_100m"] = {"nominal_n": 100_000_000,
+                               "logical_bytes": logical,
+                               "allocated_bytes": alloc,
+                               "peak_rss_gb": round(rss_gb, 2)}
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
 
     path = os.path.join(args.out, "cohort_smoke.json")
     with open(path, "w") as fh:

@@ -104,7 +104,8 @@ def build_rung_sim(n_nodes: int, degree: int, rounds: int,
 
 
 def build_cohort_rung_sim(nominal_n: int, cohort_size: int, rounds: int,
-                          history_dtype: str = "float32"):
+                          history_dtype: str = "float32",
+                          prefetch: int = 0):
     """A --cohort rung's simulator: the same LogReg round shape at a
     fixed materialized cohort C over a NOMINAL population of nominal_n
     (NominalTopology — resample-mode cohorts never read edges, so no
@@ -141,7 +142,8 @@ def build_cohort_rung_sim(nominal_n: int, cohort_size: int, rounds: int,
                            protocol=AntiEntropyProtocol.PUSH,
                            sampling_eval=0.01, eval_every=rounds,
                            history_dtype=history_dtype,
-                           cohort=CohortConfig(size=cohort_size),
+                           cohort=CohortConfig(size=cohort_size,
+                                               prefetch=prefetch),
                            sentinels=True, perf=True)
 
 
@@ -168,7 +170,8 @@ def _inject_fault(sim, n_nodes: int) -> None:
 
 def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
              history_dtype: str, fail: bool,
-             prev: dict | None, cohort_size: int | None = None) -> dict:
+             prev: dict | None, cohort_size: int | None = None,
+             prefetch: int = 0) -> dict:
     """Run one rung; returns its ladder row. Raises on rung failure with
     ``row_so_far`` / ``bundle`` attached to the exception (the driver
     turns that into the verdict). With ``cohort_size`` the rung runs in
@@ -186,8 +189,10 @@ def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
     if cohort_size:
         row["nominal_n"] = n_nodes
         row["cohort_size"] = min(cohort_size, n_nodes)
+        if prefetch:
+            row["prefetch"] = prefetch
         sim = build_cohort_rung_sim(n_nodes, cohort_size, rounds,
-                                    history_dtype)
+                                    history_dtype, prefetch=prefetch)
     else:
         sim = build_rung_sim(n_nodes, degree, rounds, history_dtype)
     row["build_seconds"] = round(time.perf_counter() - t0, 2)
@@ -227,7 +232,19 @@ def run_rung(n_nodes: int, degree: int, rounds: int, out_dir: str,
            f"analytic {(row['predicted']['flops_per_round'] or 0) / 1e6:.1f}"
            " MFLOP/round")
 
-    rung_dir = os.path.join(out_dir, f"rung_{n_nodes}")
+    # Predict-and-refuse BEFORE any state is built or launched: a rung
+    # whose construction-time budget exceeds the device (or
+    # $GOSSIPY_TPU_MEMORY_LIMIT) limit becomes a named ladder verdict
+    # instead of an opaque allocator OOM mid-run.
+    try:
+        sim.check_memory_budget()
+    except Exception as e:
+        e.ladder_row = row  # type: ignore[attr-defined]
+        e.ladder_sim = sim  # type: ignore[attr-defined]
+        raise
+
+    suffix = f"_p{prefetch}" if prefetch else ""
+    rung_dir = os.path.join(out_dir, f"rung_{n_nodes}{suffix}")
     os.makedirs(rung_dir, exist_ok=True)
     rec = FlightRecorder(rung_dir, chunk=rounds)
     key = jax.random.PRNGKey(42)
@@ -302,7 +319,7 @@ def _verdict_for(exc: Exception, n_nodes: int,
     if memory is None:
         program = "uncompiled (failed before/at compile)"
         memory = {"memory_budget_fallback": row.get("predicted")}
-    return {
+    verdict = {
         "failed_rung": n_nodes,
         "last_healthy_rung": last_healthy,
         "program": program,
@@ -311,13 +328,24 @@ def _verdict_for(exc: Exception, n_nodes: int,
         "error": repr(exc)[:500],
         "bundle": getattr(exc, "ladder_bundle", None),
     }
+    # A memory-budget refusal (predict-and-refuse, engine
+    # check_memory_budget) is a NAMED degrade, not a crash: the verdict
+    # carries the dominant budget term so the ladder.md reader knows
+    # which knob (N, history depth, cohort mode) to turn.
+    if type(exc).__name__ == "MemoryBudgetExceeded":
+        verdict["degrade_reason"] = "memory_budget_refused"
+        verdict["dominant_term"] = getattr(exc, "dominant_term", None)
+        verdict["predicted_bytes"] = getattr(exc, "predicted_bytes", None)
+        verdict["limit_bytes"] = getattr(exc, "limit_bytes", None)
+        verdict["program"] = "refused before launch (memory budget)"
+    return verdict
 
 
 def _markdown(rows: list, verdict: dict | None) -> str:
     lines = [
         "| N | nominal_n | predicted MB | pool MB | hbm peak MB | "
-        "ms/round | rounds/s | MFU est | pred/meas time |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "ms/round | rounds/s | MFU est | stream× | pred/meas time |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
 
     def mb(v):
@@ -328,10 +356,15 @@ def _markdown(rows: list, verdict: dict | None) -> str:
         mfu = m.get("mfu_est")
         # Materialized rungs: N IS the materialized width and nominal_n
         # repeats it; cohort rungs materialize only C and carry the
-        # nominal population + pool residency here.
+        # nominal population + pool residency here. --stream pairs show
+        # the prefetch depth next to the width and the measured speedup
+        # over their serial twin.
         width = r.get("cohort_size") or r["n_nodes"]
+        wcell = (f"{width:,} (pf {r['prefetch']})"
+                 if r.get("prefetch") else f"{width:,}")
+        spd = r.get("stream_speedup")
         lines.append(
-            f"| {width:,} "
+            f"| {wcell} "
             f"| {r.get('nominal_n', r['n_nodes']):,} "
             f"| {mb(p.get('total_bytes'))} "
             f"| {mb(p.get('pool_resident_bytes'))} "
@@ -339,13 +372,19 @@ def _markdown(rows: list, verdict: dict | None) -> str:
             f"| {m.get('ms_per_round') and round(m['ms_per_round'], 2)} "
             f"| {m.get('rounds_per_sec') or '—'} "
             f"| {f'{mfu:.4f}' if mfu is not None else 'null'} "
+            f"| {f'{spd:.2f}x' if spd else ''} "
             f"| {r.get('time_predicted_over_measured') or '—'} |")
     if verdict is not None:
         lines.append("")
-        lines.append(f"**FAILED** at rung {verdict['failed_rung']:,} "
-                     f"(last healthy: {verdict['last_healthy_rung']}): "
-                     f"program `{verdict['program']}`, "
-                     f"`{verdict['error']}`")
+        refused = verdict.get("degrade_reason") == "memory_budget_refused"
+        lines.append(
+            f"**{'REFUSED' if refused else 'FAILED'}** at rung "
+            f"{verdict['failed_rung']:,} "
+            f"(last healthy: {verdict['last_healthy_rung']}): "
+            f"program `{verdict['program']}`, "
+            + (f"dominant budget term `{verdict.get('dominant_term')}`, "
+               if refused else "")
+            + f"`{verdict['error']}`")
     return "\n".join(lines) + "\n"
 
 
@@ -369,6 +408,12 @@ def main(argv=None) -> int:
     ap.add_argument("--cohort-size", type=int, default=None,
                     help="materialized cohort width C for --cohort "
                          "(default 1024; 64 with --smoke)")
+    ap.add_argument("--stream", action="store_true",
+                    help="with --cohort: run each rung as a serial + "
+                         "streaming (prefetch) pair; the streaming row "
+                         "gains stream_speedup over its serial twin")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="prefetch depth for --stream rows (default 2)")
     ap.add_argument("--out", default="ladder-artifacts")
     ap.add_argument("--history-dtype", default="float32",
                     choices=("float32", "bfloat16", "int8"))
@@ -396,6 +441,9 @@ def main(argv=None) -> int:
     cohort_size = None
     if args.cohort:
         cohort_size = args.cohort_size or (64 if args.smoke else 1024)
+    if args.stream and not args.cohort:
+        print("[ladder] --stream requires --cohort", file=sys.stderr)
+        return 2
     os.makedirs(args.out, exist_ok=True)
 
     # A wedged accelerator tunnel must degrade to CPU, not hang the
@@ -425,6 +473,22 @@ def main(argv=None) -> int:
                            args.history_dtype, fail=(args.fail_at == n),
                            prev=rows[-1] if rows else None,
                            cohort_size=cohort_size)
+            if args.stream:
+                # The rung's streaming twin: same config + prefetch.
+                # Both rows land on the ladder; the streaming one prices
+                # the pipeline against its serial sibling.
+                srow = run_rung(n, degree, rounds, args.out,
+                                args.history_dtype, fail=False,
+                                prev=None, cohort_size=cohort_size,
+                                prefetch=args.prefetch)
+                ser_ms = (row.get("measured") or {}).get("ms_per_round")
+                st_ms = (srow.get("measured") or {}).get("ms_per_round")
+                if ser_ms and st_ms:
+                    srow["stream_speedup"] = round(ser_ms / st_ms, 3)
+                    _stamp(f"rung {n}: stream pair "
+                           f"{srow['stream_speedup']}x (serial "
+                           f"{ser_ms:.2f} -> prefetch {st_ms:.2f} "
+                           "ms/round)")
         except Exception as e:
             verdict = _verdict_for(e, n, last_healthy)
             rows.append(getattr(e, "ladder_row", None)
@@ -434,6 +498,8 @@ def main(argv=None) -> int:
                    f"bundle {verdict['bundle']})")
             break
         rows.append(row)
+        if args.stream:
+            rows.append(srow)
         last_healthy = n
 
     out = {"schema": 2,  # v2: + nominal_n/cohort_size/pool columns

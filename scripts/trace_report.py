@@ -18,6 +18,16 @@ Multiple inputs are merged first (``merge_traces`` — associative, so
 per-process service traces reduce in any order) and analyzed as ONE
 timeline; windows from different pids never overlap-count each other.
 
+Streaming-cohort traces (``CohortConfig(prefetch=k)``) record
+``cohort.segment`` windows that OVERLAP in time — segment t+1's sample
+and gather run while segment t executes. Attribution stays exact:
+spans carry a ``window=<round_start>`` tag binding them to their
+segment, and overlap/blocked time is measured against the pid-wide
+device union, so a gather hidden behind a neighboring segment's run
+counts as overlap (see :func:`~gossipy_tpu.telemetry.tracing.
+trace_report`). Serial traces are reduced identically — their numbers
+do not change.
+
 ``--bench-row`` stamps ``raw.host_blocked_frac`` (and
 ``raw.trace_overlap_frac``) into an existing bench-row JSON file in
 place, so ``scripts/bench_trend.py`` can fold host-efficiency into the

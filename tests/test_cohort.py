@@ -9,6 +9,7 @@ The ISSUE-14 acceptance pair this file carries:
 """
 
 import json
+import os
 
 import jax
 import numpy as np
@@ -194,7 +195,9 @@ class TestResampleRounds:
         m = sim.run_manifest().to_dict()
         assert m["config"]["cohort"] == {"size": 16,
                                         "rounds_per_cohort": 1,
-                                        "peer_mode": "resample"}
+                                        "peer_mode": "resample",
+                                        "prefetch": 0,
+                                        "pool_dir": None}
         assert m["config"]["nominal_n"] == 64
         assert m["config"]["topology"] == "Topology"
         assert any("history_scale" in p
@@ -353,3 +356,224 @@ class TestReportRoundTrip:
         cat = SimulationReport.concatenate([r1, r2])
         assert cat.cohort_coverage.shape == (6,)
         assert (cat.cohort_active_nodes == 16).all()
+
+
+def make_stream_sim(nominal=96, cohort=24, prefetch=0, rpc=1,
+                    pool_dir=None, lr=0.1):
+    """A cohort sim with the streaming-pipeline knobs exposed."""
+    return GossipSimulator(
+        make_handler(lr), Topology.random_regular(nominal, 6, seed=3),
+        make_data(min(nominal, 64)), delta=20,
+        protocol=AntiEntropyProtocol.PUSH,
+        cohort=CohortConfig(size=cohort, rounds_per_cohort=rpc,
+                            prefetch=prefetch, pool_dir=pool_dir))
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def serial_oracle8():
+    """The 8-round SERIAL pool+report for the make_stream_sim config —
+    the one oracle the streaming/mesh equivalence tests compare against
+    (shared: each serial rerun would re-trace the segment program)."""
+    key = jax.random.PRNGKey(0)
+    sim = make_stream_sim(prefetch=0)
+    return sim.start(sim.init_cohort_pool(key), n_rounds=8, key=key)
+
+
+class TestStreamingPipeline:
+    """CohortConfig(prefetch=k): the double-buffered driver must be a
+    pure scheduling change — bit-identical pools to the serial loop."""
+
+    def test_prefetch_config_validation(self):
+        with pytest.raises(ValueError):
+            CohortConfig(size=8, prefetch=-1)
+        with pytest.raises(ValueError):
+            CohortConfig(size=8, pool_dir=123)
+        cfg = CohortConfig.coerce({"size": 8, "prefetch": 3,
+                                   "pool_dir": "/tmp/x"})
+        assert cfg.prefetch == 3 and cfg.pool_dir == "/tmp/x"
+        assert CohortConfig.coerce(cfg.to_dict()) == cfg
+
+    def test_streaming_equals_serial_bit_for_bit(self, key,
+                                                 serial_oracle8):
+        """K segments streamed at a shallow and a deep depth == the
+        serial schedule, every pool leaf bit-identical (model, phase,
+        node keys, touched, round) and the report rows equal too.
+        (Depths in between ride the tail/overlap/checkpoint tests.)"""
+        p_serial, r_serial = serial_oracle8
+        for prefetch in (1, 4):
+            st = make_stream_sim(prefetch=prefetch)
+            p_stream, r_stream = st.start(st.init_cohort_pool(key),
+                                          n_rounds=8, key=key)
+            _leaves_equal(p_serial, p_stream)
+            np.testing.assert_array_equal(r_serial.sent_per_round,
+                                          r_stream.sent_per_round)
+            np.testing.assert_allclose(r_serial.cohort_coverage,
+                                       r_stream.cohort_coverage, rtol=0)
+            np.testing.assert_array_equal(r_serial.cohort_active_nodes,
+                                          r_stream.cohort_active_nodes)
+
+    def test_streaming_tail_segment(self, key):
+        """rounds not divisible by rounds_per_cohort: the short tail
+        segment streams identically too."""
+        sa = make_stream_sim(prefetch=0, rpc=3)
+        sb = make_stream_sim(prefetch=2, rpc=3)
+        pa, _ = sa.start(sa.init_cohort_pool(key), n_rounds=7, key=key)
+        pb, _ = sb.start(sb.init_cohort_pool(key), n_rounds=7, key=key)
+        _leaves_equal(pa, pb)
+
+    def test_streaming_overlapping_cohorts_patch(self, key):
+        """Small N/C ratio forces consecutive cohorts to intersect, so
+        staged gathers MUST be patched with in-flight outputs — the
+        exact hazard the pending/recent overlay protocol exists for."""
+        sa = make_stream_sim(nominal=32, cohort=16, prefetch=0)
+        sb = make_stream_sim(nominal=32, cohort=16, prefetch=3)
+        pa, _ = sa.start(sa.init_cohort_pool(key), n_rounds=10, key=key)
+        pb, _ = sb.start(sb.init_cohort_pool(key), n_rounds=10, key=key)
+        _leaves_equal(pa, pb)
+
+    def test_streaming_checkpoint_midrun(self, key, tmp_path,
+                                         serial_oracle8):
+        """save/load mid-run UNDER prefetch, continue streamed ==
+        straight-through serial (the shared oracle)."""
+        s1 = make_stream_sim(prefetch=2)
+        pool, _ = s1.start(s1.init_cohort_pool(key), n_rounds=4, key=key)
+        path = s1.save(str(tmp_path / "ck"), pool, key=key)
+        restored, rkey = s1.load(path, key)
+        cont, _ = s1.start(restored, n_rounds=4, key=rkey)
+        _leaves_equal(cont, serial_oracle8[0])
+
+
+class TestMeshShardedRounds:
+    """start(..., mesh=): [C]-wide rounds sharded along the node axis
+    through the parallel/rules.py registry."""
+
+    def _mesh(self, n=8):
+        from gossipy_tpu.parallel import make_mesh
+        return make_mesh(n)
+
+    def test_mesh_equals_unsharded(self, key, serial_oracle8):
+        mesh = self._mesh()
+        ss = make_stream_sim()
+        ps, _ = ss.start(ss.init_cohort_pool(key), n_rounds=8, key=key,
+                         mesh=mesh)
+        _leaves_equal(serial_oracle8[0], ps)
+
+    def test_mesh_with_prefetch(self, key, serial_oracle8):
+        """mesh + prefetch compose: sharded streamed == serial oracle."""
+        mesh = self._mesh()
+        ss = make_stream_sim(prefetch=2)
+        ps, _ = ss.start(ss.init_cohort_pool(key), n_rounds=8, key=key,
+                         mesh=mesh)
+        _leaves_equal(serial_oracle8[0], ps)
+
+    def test_mesh_divisibility_enforced(self, key):
+        sim = make_stream_sim(cohort=20)  # 20 % 8 != 0
+        pool = sim.init_cohort_pool(key)
+        with pytest.raises(ValueError, match="not divisible"):
+            sim.start(pool, n_rounds=1, key=key, mesh=self._mesh())
+
+    def test_non_cohort_mesh_rejected(self, key):
+        sim = make_sim(nominal=16, cohort=None, data_shards=16)
+        st = sim.init_nodes(key)
+        with pytest.raises(ValueError, match="cohort"):
+            sim.start(st, n_rounds=1, key=key, mesh=self._mesh())
+
+    def test_no_hand_placed_specs_in_cohort(self):
+        """The mesh path must place every array through the
+        parallel/rules.py registry: no PartitionSpec constructor call
+        exists in simulation/cohort.py (or engine.py)."""
+        import ast as _ast
+        import pathlib
+        pkg = pathlib.Path(__file__).resolve().parent.parent \
+            / "gossipy_tpu" / "simulation"
+        for f in (pkg / "cohort.py", pkg / "engine.py"):
+            tree = _ast.parse(f.read_text())
+            for node in _ast.walk(tree):
+                if not isinstance(node, _ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, _ast.Name)
+                        else fn.attr if isinstance(fn, _ast.Attribute)
+                        else None)
+                assert name not in ("P", "PartitionSpec"), \
+                    f"hand-placed PartitionSpec at {f.name}:{node.lineno}"
+
+
+class TestDiskBackedPool:
+    """CohortConfig(pool_dir=...): sparse mmap pools — nominal N bounded
+    by storage, not RAM."""
+
+    def test_create_run_resume(self, key, tmp_path):
+        pd = str(tmp_path / "pool")
+        s1 = make_stream_sim(prefetch=2, pool_dir=pd)
+        pool = s1.init_cohort_pool(key)
+        assert isinstance(jax.tree_util.tree_leaves(pool.model)[0],
+                          np.memmap)
+        pool, _ = s1.start(pool, n_rounds=4, key=key)
+        # Reopening the directory resumes at the stored round.
+        s2 = make_stream_sim(prefetch=0, pool_dir=pd)
+        pool2 = s2.init_cohort_pool(key)
+        assert int(np.asarray(pool2.round)) == 4
+
+    def test_checkpoint_restore_continue_deterministic(self, key,
+                                                       tmp_path):
+        """Checkpoints are file copies; a restored run continues exactly
+        like an uninterrupted disk-backed run with the same key."""
+        pd1 = str(tmp_path / "a")
+        s1 = make_stream_sim(prefetch=2, pool_dir=pd1)
+        mid, _ = s1.start(s1.init_cohort_pool(key), n_rounds=3, key=key)
+        ck = s1.save(str(tmp_path / "ck"), mid, key=key)
+        restored, rkey = s1.load(ck)
+        fin_a, _ = s1.start(restored, n_rounds=3, key=rkey)
+        pd2 = str(tmp_path / "b")
+        s2 = make_stream_sim(prefetch=2, pool_dir=pd2)
+        fin_b, _ = s2.start(s2.init_cohort_pool(key), n_rounds=6,
+                            key=key)
+        _leaves_equal(fin_a.model, fin_b.model)
+        np.testing.assert_array_equal(np.asarray(fin_a.touched),
+                                      np.asarray(fin_b.touched))
+
+    def test_local_train_rejected(self, key, tmp_path):
+        sim = make_stream_sim(pool_dir=str(tmp_path / "p"))
+        with pytest.raises(ValueError, match="local_train"):
+            sim.init_cohort_pool(key, local_train=True)
+
+    @pytest.mark.slow
+    def test_nominal_too_large_for_ram(self, key, tmp_path):
+        """Nominal N whose dense float32 pool (~23 GB of model rows
+        alone) cannot be a RAM numpy array: the sparse mmap pool runs a
+        short streamed segment loop with bounded disk allocation."""
+        import resource
+        n, c = 50_000_000, 32
+        pd = str(tmp_path / "big")
+        sim = GossipSimulator(
+            make_handler(0.1), NominalTopology(n), make_data(64),
+            delta=20, protocol=AntiEntropyProtocol.PUSH,
+            cohort=CohortConfig(size=c, prefetch=2, pool_dir=pd))
+        pool = sim.init_cohort_pool(key)
+        pool, _ = sim.start(pool, n_rounds=3, key=key)
+        assert int(np.asarray(pool.round)) == 3
+        logical = sum(os.stat(os.path.join(pd, f)).st_size
+                      for f in os.listdir(pd))
+        allocated = sum(os.stat(os.path.join(pd, f)).st_blocks * 512
+                        for f in os.listdir(pd))
+        assert logical > 2e9          # nominal-sized address space
+        assert allocated < 5e8, allocated  # but sparse on disk
+        rss_gb = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1e6
+        assert rss_gb < 8, rss_gb     # and never materialized in RAM
+        # Checkpoints stay O(written rows): hole-preserving copies.
+        ck = sim.save(str(tmp_path / "ck"), pool, key=key)
+        ck_alloc = sum(os.stat(os.path.join(ck, f)).st_blocks * 512
+                       for f in os.listdir(ck))
+        assert ck_alloc < 5e8, ck_alloc
+        restored, _ = sim.load(ck)
+        assert int(np.asarray(restored.round)) == 3

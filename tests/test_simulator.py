@@ -308,6 +308,42 @@ class TestMemoryBudget:
         assert b["aux_bytes"] is not None
         assert b["aux_bytes"] > 2 * b["model_and_opt_bytes"]
 
+    def test_check_refuses_below_predicted_and_names_dominant(self, key):
+        """Predict-and-refuse regression: the refusal pins the predicted
+        bytes to the budget total, names the largest term, and the exact
+        total passes — the boundary is the budget itself, not a fudge."""
+        from gossipy_tpu.simulation import MemoryBudgetExceeded
+        sim = make_sim()
+        b = sim.memory_budget()
+        total = int(b["total_bytes"])
+        with pytest.raises(MemoryBudgetExceeded) as ei:
+            sim.check_memory_budget(limit_bytes=total - 1)
+        e = ei.value
+        assert e.predicted_bytes == total
+        assert e.limit_bytes == total - 1
+        terms = {k: v for k, v in b.items()
+                 if k.endswith("_bytes") and k != "total_bytes"
+                 and v is not None}
+        assert e.dominant_term == max(terms, key=terms.get)
+        assert e.dominant_term in str(e)  # the ladder verdict's name
+        assert e.budget["total_bytes"] == total
+        # Exactly at the limit: fits, returns the budget dict.
+        ok = sim.check_memory_budget(limit_bytes=total)
+        assert ok["total_bytes"] == total
+
+    def test_check_env_limit_hook(self, key, monkeypatch):
+        from gossipy_tpu.simulation import MemoryBudgetExceeded
+        sim = make_sim()
+        monkeypatch.setenv("GOSSIPY_TPU_MEMORY_LIMIT", "4096")
+        with pytest.raises(MemoryBudgetExceeded):
+            sim.check_memory_budget()
+        monkeypatch.setenv("GOSSIPY_TPU_MEMORY_LIMIT", str(2**40))
+        assert sim.check_memory_budget()["total_bytes"] \
+            == sim.memory_budget()["total_bytes"]
+        # Explicit argument wins over the env hook.
+        with pytest.raises(MemoryBudgetExceeded):
+            sim.check_memory_budget(limit_bytes=4096)
+
 
 class TestMessageAccounting:
     def test_sizes_accumulate(self, key):

@@ -262,6 +262,61 @@ class TestTraceReport:
             assert r["device_ms"] == pytest.approx(0.25)
             assert r["overlap_frac"] == pytest.approx(0.2 / 0.3, abs=1e-3)
 
+    def test_overlapping_windows_tag_attribution(self):
+        """The streaming-pipeline shape: two ``cohort.segment`` windows
+        overlapping in time, children routed by their ``window=`` tag —
+        NOT by containment (which is ambiguous here), and overlap
+        measured against the pid-wide device union so a gather hidden
+        under the OTHER window's device time counts as overlapped."""
+        tr = self._tracer()
+        # Window A [0, 1000], window B [500, 1500] — overlap [500, 1000].
+        tr.add_complete("cohort.segment", 0.0, 1000.0, cat="cohort",
+                        tid=1, args={"round_start": 0, "rounds": 1,
+                                     "streaming": True})
+        tr.add_complete("cohort.segment", 500.0, 1000.0, cat="cohort",
+                        tid=1, args={"round_start": 1, "rounds": 1,
+                                     "streaming": True})
+        # A's host work [50, 150] + its device window [200, 900] (the
+        # wait span is excluded from host time).
+        tr.add_complete("cohort.stage", 50.0, 100.0, cat="cohort",
+                        tid=1, args={"window": 0})
+        tr.add_complete("cohort.run", 200.0, 700.0, cat=WAIT_CAT,
+                        tid=1, args={"window": 0})
+        attach_device_spans(tr, 200.0, 700.0, args={"window": 0})
+        # B's stager gather [600, 800]: inside BOTH window intervals —
+        # containment alone cannot attribute it; the tag routes it to B,
+        # where it is fully hidden under A's device time -> pure overlap.
+        tr.add_complete("cohort.gather", 600.0, 200.0, cat="cohort",
+                        tid=2, args={"window": 1})
+        # A's flush scatter [1050, 1150]: AFTER A's interval (inside
+        # B's) — the tag still routes it to A, as blocked host time.
+        tr.add_complete("cohort.scatter", 1050.0, 100.0, cat="cohort",
+                        tid=3, args={"window": 0})
+        rep = trace_report(tr.snapshot())
+        assert rep["n_windows"] == 2
+        a, b = rep["windows"]
+        assert (a["round_start"], b["round_start"]) == (0, 1)
+        assert a["host_busy_ms"] == pytest.approx(0.2)     # stage+scatter
+        assert a["host_blocked_ms"] == pytest.approx(0.2)  # none hidden
+        assert a["device_ms"] == pytest.approx(0.7)
+        assert a["overlap_ms"] == pytest.approx(0.0)
+        assert a["unaccounted_ms"] == pytest.approx(0.1)
+        assert b["host_busy_ms"] == pytest.approx(0.2)     # the gather
+        assert b["overlap_ms"] == pytest.approx(0.2)       # under A's dev
+        assert b["host_blocked_ms"] == pytest.approx(0.0)
+        assert b["device_ms"] == pytest.approx(0.0)        # owns none
+        assert b["overlap_frac"] == pytest.approx(1.0)
+        t = rep["totals"]
+        assert t["wall_ms"] == pytest.approx(2.0)
+        assert t["overlap_frac"] == pytest.approx(0.5)
+        assert t["host_blocked_frac"] == pytest.approx(0.1)
+        # Nothing double-counted: each child lands in exactly one window.
+        assert t["host_busy_ms"] == pytest.approx(0.4)
+        assert [r["round"] for r in rep["per_round"]] == [1, 2]
+        ranked = {r["name"]: r["ms"] for r in rep["critical_path"]}
+        assert ranked["device.execute"] == pytest.approx(0.7)
+        assert ranked["cohort.gather"] == pytest.approx(0.0)
+
     def test_critical_path_ranks_non_overlapped(self):
         tr = self._tracer()
         tr.add_complete("w", 0.0, 1000.0, cat="engine", tid=1,
