@@ -113,6 +113,18 @@ def emit(payload: dict) -> None:
                        "backend initialized as cpu (accelerator absent or "
                        "plugin fell back)")
     print(json.dumps(payload))
+    try:
+        # Run-ledger ingest (telemetry.ledger; opt-in via the
+        # GOSSIPY_TPU_LEDGER env var): every emitted row also lands as a
+        # digest row in the process's run index. Best-effort — the
+        # stdout one-line contract above is the measurement of record.
+        from gossipy_tpu.telemetry.ledger import (ingest_bench_capsule,
+                                                  resolve_ledger)
+        led = resolve_ledger(None)
+        if led is not None:
+            ingest_bench_capsule(led, payload)
+    except Exception as e:
+        print(f"[ledger] ingest failed: {e!r}", file=sys.stderr)
 
 
 def emit_manifest(sim, mode: str) -> None:

@@ -27,6 +27,7 @@ changed layout, a donation difference) moves the fingerprint.
 from __future__ import annotations
 
 import hashlib
+import os
 import re
 from typing import Optional
 
@@ -175,6 +176,14 @@ def _make_sim(cls=None, *, all2all=False, sparse_mix_form=None, **kwargs):
                protocol=AntiEntropyProtocol.PUSH, **kwargs)
 
 
+def _tmp_ledger():
+    import tempfile
+
+    from ..telemetry.ledger import RunLedger
+    return RunLedger(os.path.join(tempfile.mkdtemp(prefix="hlo_ledger_"),
+                                  "ledger.jsonl"))
+
+
 def _small_chaos():
     from ..simulation import ChaosConfig, PartitionEpisode
     half = tuple(range(_N // 2)), tuple(range(_N // 2, _N))
@@ -218,6 +227,11 @@ def gate_cases() -> dict:
         # and metrics: a live tracer must be HLO-invisible even when ON.
         ("engine/tracing-on",
          lambda: _make_sim(), lambda: _make_sim(tracing=True)),
+        # run-ledger feed (telemetry.ledger) is host-side only, same
+        # contract: an attached ledger (post-run digest appends) must be
+        # HLO-invisible even when ON.
+        ("engine/ledger-on",
+         lambda: _make_sim(), lambda: _make_sim(ledger=_tmp_ledger())),
         ("all2all/sentinels-off",
          lambda: _make_sim(all2all=True),
          lambda: _make_sim(all2all=True, sentinels=None)),

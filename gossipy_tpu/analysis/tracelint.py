@@ -60,6 +60,11 @@ trace-in-trace      a call that resolves into ``telemetry.tracing``
                     (span/counter/tracer APIs) reachable from a traced
                     root — the span tracer is a host-side sink under the
                     same contract; span host segments, not jitted code
+ledger-in-trace     a call that resolves into ``telemetry.ledger``
+                    (RunLedger appends, ingest adapters) reachable from
+                    a traced root — the run ledger is a host-side sink
+                    under the same contract; append digest rows after
+                    the run, never inside jitted code
 =================== =====================================================
 
 Suppression: append ``# tracelint: disable=<rule>[,<rule>...]`` (or
@@ -89,6 +94,8 @@ ALL_RULES = {
     "schema-tolerance": "JSONL SCHEMA bumped past parse_line's tolerance",
     "metrics-in-trace": "telemetry.metrics registry call in a traced region",
     "trace-in-trace": "telemetry.tracing span/tracer call in a traced region",
+    "ledger-in-trace": "telemetry.ledger append/ingest call in a traced "
+                       "region",
 }
 
 # The SLO metrics registry (telemetry.metrics) is a HOST sink by
@@ -104,6 +111,13 @@ _METRICS_MODULE = "gossipy_tpu/telemetry/metrics.py"
 # nonsense once per compile — and wall timestamps are meaningless inside
 # a trace anyway.
 _TRACING_MODULE = "gossipy_tpu/telemetry/tracing.py"
+
+# The run ledger (telemetry.ledger) is the SAME kind of host sink:
+# digest rows are appended after a run finishes (engine start() tail,
+# service tenant finalize), never from jitted code. A ledger call
+# reachable from a traced root would fsync a file once per COMPILE with
+# trace-time constants — and stall the trace on disk I/O besides.
+_LEDGER_MODULE = "gossipy_tpu/telemetry/ledger.py"
 
 # Call-name suffix -> positions of function-valued operands that are traced.
 # None means "every positional argument from index 0" (switch: from 1).
@@ -1206,14 +1220,24 @@ def run_tracelint(root, sources: Optional[dict] = None,
             "span the host segment around the jitted call instead",
             mod, node)
 
+    def _ledger_finding(mod: _Module, node: ast.Call):
+        _host_sink_finding(
+            "ledger-in-trace",
+            "telemetry.ledger append/ingest call reachable from a "
+            "traced root — the run ledger is a host-side sink (same "
+            "contract as io_callback bodies, the metrics registry and "
+            "the span tracer); append the digest row after the run "
+            "finishes, never from jitted code", mod, node)
+
     # Propagate tracedness through repo-internal calls. Only a function's
     # OWN code propagates — nested defs are separate regions reached via
     # resolve_call (so an io_callback body inside a traced method never
     # drags its host-side helpers into the traced set). A call resolving
-    # into telemetry.metrics or telemetry.tracing does NOT propagate — it
-    # is reported as a metrics-in-trace / trace-in-trace finding instead
-    # (both are host sinks by contract; tracing into them would also
-    # mis-lint their own host code).
+    # into telemetry.metrics, telemetry.tracing or telemetry.ledger does
+    # NOT propagate — it is reported as a metrics-in-trace /
+    # trace-in-trace / ledger-in-trace finding instead (all three are
+    # host sinks by contract; tracing into them would also mis-lint
+    # their own host code).
     while worklist:
         fn = worklist.pop()
         mod = modules[fn.module]
@@ -1224,6 +1248,8 @@ def run_tracelint(root, sources: Optional[dict] = None,
                         _metrics_finding(mod, node)
                     elif callee.module == _TRACING_MODULE:
                         _tracing_finding(mod, node)
+                    elif callee.module == _LEDGER_MODULE:
+                        _ledger_finding(mod, node)
                     else:
                         add(callee)
     for fn in traced.values():

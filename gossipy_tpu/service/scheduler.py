@@ -136,9 +136,14 @@ class _BucketRuntime:
     def __init__(self, bucket: Bucket, out_root: str, slice_rounds: int,
                  keep_repro: bool, events_jsonl: bool,
                  registry: Optional[MetricsRegistry] = None,
-                 mesh=None, tracer=None):
+                 mesh=None, tracer=None, ledger=None):
         self.bucket = bucket
         self.mesh = mesh
+        # Run ledger (telemetry.ledger), shared across the session's
+        # buckets: every finalized tenant appends one digest row, so SLO
+        # accounting is continuous across process restarts — a resumed
+        # queue served by a fresh service appends to the same file.
+        self.ledger = ledger
         self._reg = registry if registry is not None else get_registry()
         self._m = _service_metrics(self._reg)
         self._digest8 = bucket.signature.digest[:8]
@@ -644,13 +649,60 @@ class _BucketRuntime:
             h.report.save(path)
             h.artifacts["report"] = path
         path = os.path.join(out, "manifest.json")
-        self._tenant_manifest(i).save(path)
+        manifest = self._tenant_manifest(i)
+        manifest.save(path)
         h.artifacts["manifest"] = path
+        self._ledger_append(i, manifest)
         self._senders[i]._notify_end()
         rx = self._receivers[i]
         if rx is not None:
             rx.close()
             self._receivers[i] = None
+
+    def _ledger_append(self, i: int, manifest: RunManifest) -> None:
+        """One digest row per finalized tenant (telemetry.ledger; no-op
+        without a ledger): status + SLO percentiles + hashed artifact
+        paths, with the tenant's own ExperimentConfig pinned under
+        ``experiment`` so ``scripts/ledger.py bisect`` can replay it.
+        Best-effort — a ledger problem must never fail a finalize."""
+        if self.ledger is None:
+            return
+        try:
+            import dataclasses
+
+            from ..telemetry import ledger as _ledger
+            run = self.bucket.runs[i]
+            h = run.handle
+            slo = self._tenant_slo(i)
+            p50 = slo.get("bucket_round_seconds_p50")
+            p99 = slo.get("bucket_round_seconds_p99")
+            metrics = {
+                "slo_p50_ms": p50 * 1000.0 if p50 is not None else None,
+                "slo_p99_ms": p99 * 1000.0 if p99 is not None else None,
+            }
+            if h.report is not None:
+                for name in ("accuracy", "auc", "f1"):
+                    acc = h.report.final(name)
+                    if acc == acc:
+                        metrics["final_accuracy"] = acc
+                        break
+            failure = None
+            if h.status is not RunStatus.DONE:
+                failure = {"kind": h.status.value, "error": h.error}
+                if h.bundle_path:
+                    failure["bundle"] = h.bundle_path
+            _ledger.ingest_manifest(
+                self.ledger, manifest, kind="tenant",
+                metrics=metrics, failure=failure,
+                artifacts=dict(h.artifacts),
+                experiment=dataclasses.asdict(run.request.config),
+                extra={"tenant": run.tenant,
+                       "bucket": self.bucket.signature.digest,
+                       "status": h.status.value,
+                       "rounds_completed": h.rounds_completed,
+                       "slo": slo})
+        except Exception:
+            pass
 
     def _evict(self, i: int, bad_round: int, rows: dict) -> None:
         """Sentinel trip: write the tenant's repro bundle from its last
@@ -767,7 +819,7 @@ class GossipService:
                  events_jsonl: bool = True,
                  metrics_dir: Optional[str] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 mesh=None, tracing=None):
+                 mesh=None, tracing=None, ledger=None):
         # Optional jax.sharding.Mesh: when given, every bucket's
         # megabatch state/data placement is derived from the partition-
         # rule registry (parallel/rules.py) instead of single-device
@@ -794,6 +846,14 @@ class GossipService:
             self.tracer = _tracing.ensure_tracer()
         else:
             self.tracer = tracing
+        # Run ledger (telemetry.ledger): same resolution contract as
+        # GossipSimulator(ledger=...) — None consults the
+        # GOSSIPY_TPU_LEDGER env var, False off, path/RunLedger
+        # explicit. When on, every finalized tenant appends one digest
+        # row, making SLO accounting continuous across restarts (a
+        # resumed queue appends to the same ledger file).
+        from ..telemetry.ledger import resolve_ledger
+        self.ledger = resolve_ledger(ledger)
 
     def run(self, requests: list[RunRequest]) -> dict:
         """Serve a fixed batch of requests (sugar over :meth:`serve`)."""
@@ -878,7 +938,7 @@ class ServiceSession:
         new = [_BucketRuntime(b, svc.out_dir, svc.slice_rounds,
                               svc.keep_repro, svc.events_jsonl,
                               registry=svc.registry, mesh=svc.mesh,
-                              tracer=svc.tracer)
+                              tracer=svc.tracer, ledger=svc.ledger)
                for b in buckets]
         for rt in new:
             rt.initialize()

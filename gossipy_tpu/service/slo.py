@@ -83,7 +83,8 @@ def run_load(out_dir: str, pool: Optional[Sequence[dict]] = None,
              seed: int = 0, slice_rounds: int = 3,
              metrics_dir: Optional[str] = None,
              registry: Optional[MetricsRegistry] = None,
-             time_scale: float = 1.0, tracing=None) -> dict:
+             time_scale: float = 1.0, tracing=None,
+             ledger=None) -> dict:
     """Run the sustained-arrival load and return ``{"row": service_slo
     bench row, "summary": service summary, "queue": RunQueue}``.
 
@@ -97,12 +98,16 @@ def run_load(out_dir: str, pool: Optional[Sequence[dict]] = None,
     when on, every arrival lands as an instant marker + queue-depth
     counter on the service's trace timeline, and the session writes
     ``trace.json`` next to ``metrics.json`` each poll cycle.
+
+    ``ledger`` follows the same contract (telemetry.ledger.
+    resolve_ledger): when on, every finalized tenant appends a digest
+    row — the continuous-across-restarts SLO account.
     """
     reg = registry if registry is not None else get_registry()
     pool = list(pool) if pool is not None else default_spec_pool()
     svc = GossipService(out_dir, slice_rounds=slice_rounds,
                         metrics_dir=metrics_dir, registry=reg,
-                        tracing=tracing)
+                        tracing=tracing, ledger=ledger)
     tracer = svc.tracer
     queue = RunQueue()
     session = svc.session(queue)

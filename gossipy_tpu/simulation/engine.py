@@ -520,7 +520,8 @@ class GossipSimulator(SimulationEventSender):
                  perf: Union[None, bool, PerfConfig] = None,
                  metrics: Union[None, bool] = None,
                  cohort=None,
-                 tracing=None):
+                 tracing=None,
+                 ledger=None):
         assert 0 <= drop_prob < 1 and 0 < online_prob <= 1
         if history_dtype not in self._HISTORY_DTYPES:
             raise ValueError(
@@ -738,6 +739,18 @@ class GossipSimulator(SimulationEventSender):
             self.tracer = ensure_tracer()
         else:
             self.tracer = tracing
+        # Run-ledger feed (telemetry.ledger): host-side ONLY like perf/
+        # metrics/tracing — ledger on and off compile byte-identical HLO
+        # (gate pair engine/ledger-on) and tracelint's ledger-in-trace
+        # rule proves nothing traced can reach it. None consults the
+        # GOSSIPY_TPU_LEDGER env var (unset = off), False is strictly
+        # off, a path string / RunLedger instance is explicit. Every
+        # finished start() segment appends one digest row (run id shared
+        # across a chunked run's segments); appends are best-effort — a
+        # ledger problem must never take down a finished run.
+        from ..telemetry.ledger import resolve_ledger
+        self.ledger = resolve_ledger(ledger)
+        self._ledger_run_id: Optional[str] = None
         self.chaos: Optional[ChaosConfig] = ChaosConfig.coerce(chaos)
         self.chaos_schedule = None
         self._chaos_edge_form: Optional[str] = None
@@ -2499,7 +2512,9 @@ class GossipSimulator(SimulationEventSender):
         """
         if self.cohort is not None:
             from .cohort import cohort_start
-            return cohort_start(self, state, n_rounds, key, mesh=mesh)
+            out = cohort_start(self, state, n_rounds, key, mesh=mesh)
+            self._ledger_append(out[1], n_rounds, None)
+            return out
         if mesh is not None:
             raise ValueError(
                 "start(mesh=) is the cohort-mode sharded-round path; "
@@ -2647,7 +2662,48 @@ class GossipSimulator(SimulationEventSender):
                                    include_live=live_fallback)
             if live_times:
                 report.attach_wall_clock(t_run0, live_times)
+        # Outside the trace window: the digest append is ledger
+        # bookkeeping, not run work. exec_seconds only measured the run
+        # when something forced the completion sync (perf timing / a
+        # live tracer); otherwise it timed the async dispatch only and
+        # would fabricate a throughput.
+        self._ledger_append(report, n_rounds,
+                            exec_seconds if (perf_timing or tr is not None)
+                            else None, round_start=first_round)
         return state, report
+
+    def _ledger_append(self, report, n_rounds: int,
+                       exec_seconds: Optional[float],
+                       round_start: Optional[int] = None) -> Optional[dict]:
+        """Append this segment's digest row to the run ledger (telemetry.
+        ledger; no-op without one). Host-side, post-run, best-effort —
+        never raises into a finished run. Segments of one chunked run
+        share the simulator's ledger run id."""
+        if self.ledger is None:
+            return None
+        try:
+            from ..telemetry import ledger as _ledger
+            metrics: dict = {}
+            if exec_seconds and exec_seconds > 0:
+                metrics["rounds_per_sec"] = round(n_rounds / exec_seconds,
+                                                  3)
+            perf_last = getattr(self, "_perf_last", None) or {}
+            metrics["mfu_est"] = perf_last.get("mfu_est")
+            for name in ("accuracy", "auc", "f1"):
+                acc = report.final(name)
+                if acc == acc:  # first non-NaN eval metric is headline
+                    metrics["final_accuracy"] = acc
+                    break
+            extra = {"rounds": int(n_rounds)}
+            if round_start is not None:
+                extra["round_start"] = int(round_start)
+            row = _ledger.ingest_manifest(
+                self.ledger, self.run_manifest(), kind="engine",
+                run_id=self._ledger_run_id, metrics=metrics, extra=extra)
+            self._ledger_run_id = row["run_id"]
+            return row
+        except Exception:
+            return None
 
     def _build_report(self, stats: dict) -> SimulationReport:
         def opt(k):

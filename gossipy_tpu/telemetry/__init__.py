@@ -40,6 +40,19 @@ other way, so everything here is importable standalone):
   the same contract as metrics: tracing on/off compiles byte-identical
   HLO, and the tracelint ``trace-in-trace`` rule enforces
   never-in-a-trace.
+- :mod:`.ledger` — the crash-safe append-only run index
+  (:class:`RunLedger`): one fsync'd CRC-framed JSONL file every
+  producer (engine ``start()``, service tenant finalize, bench rows,
+  ladder rungs, loadgen SLO rows, flight-recorder bundles) appends a
+  schema-stamped digest row to via the ``ingest_*`` adapters — run id,
+  code version, config fingerprint, headline metrics, failure causes,
+  hashed artifact paths. A torn final record (``kill -9`` mid-append)
+  is skipped on read and repaired by the next append; ledgers merge
+  associatively (:func:`merge_ledgers`, the ``merge_traces`` contract).
+  Host-only like metrics/tracing: ledger on/off compiles byte-identical
+  HLO and the tracelint ``ledger-in-trace`` rule enforces
+  never-in-a-trace. ``scripts/ledger.py`` is the forensics CLI
+  (list/show/diff/trend/bisect).
 - :mod:`.cost` — :class:`PerfConfig` and the host-side performance
   observability layer (``perf=``): per-compiled-program
   :class:`CostReport` (XLA cost/memory analysis), the analytic
@@ -76,7 +89,29 @@ from .health import (
     per_node_param_norm,
     replay_bundle,
 )
-from .manifest import MANIFEST_SCHEMA, RunManifest, git_revision
+from .ledger import (
+    HEADLINE_METRICS,
+    LEDGER_ENV,
+    LEDGER_SCHEMA,
+    RunLedger,
+    config_fingerprint,
+    ingest_bench_capsule,
+    ingest_bundle,
+    ingest_ladder,
+    ingest_manifest,
+    ingest_slo_row,
+    ingest_trace_report,
+    merge_ledger_files,
+    merge_ledgers,
+    resolve_ledger,
+)
+from .manifest import (
+    MANIFEST_SCHEMA,
+    RunManifest,
+    code_version_block,
+    git_dirty,
+    git_revision,
+)
 from .metrics import (
     DEFAULT_BUCKETS,
     METRICS_SCHEMA,
@@ -123,7 +158,13 @@ from .tracing import (
 
 __all__ = [
     "FAILURE_CAUSES", "FailureCounts",
-    "RunManifest", "MANIFEST_SCHEMA", "git_revision",
+    "RunManifest", "MANIFEST_SCHEMA", "git_revision", "git_dirty",
+    "code_version_block",
+    "RunLedger", "LEDGER_SCHEMA", "LEDGER_ENV", "HEADLINE_METRICS",
+    "config_fingerprint", "resolve_ledger",
+    "ingest_manifest", "ingest_bench_capsule", "ingest_trace_report",
+    "ingest_ladder", "ingest_slo_row", "ingest_bundle",
+    "merge_ledgers", "merge_ledger_files",
     "PHASE_SEND", "PHASE_RECEIVE_MERGE", "PHASE_TRAIN", "PHASE_EVAL",
     "PHASE_REPLY", "ROUND_PHASES", "phase_scope", "phases_in_text",
     "phases_in_trace_dir",

@@ -450,6 +450,16 @@ class FlightRecorder:
                     fh.write(json.dumps(ev.to_dict()) + "\n")
 
         self.bundle_path = path
+        # Crash bundles are first-class run-ledger rows (verdict inline,
+        # bundle + manifest as hashed artifacts) — opt-in via the
+        # GOSSIPY_TPU_LEDGER env var, best-effort like the manifest.
+        try:
+            from .ledger import ingest_bundle, resolve_ledger
+            led = resolve_ledger(None)
+            if led is not None:
+                ingest_bundle(led, path)
+        except Exception:
+            pass
         return path
 
     def write_bundle(self, sim, state, key, kind: str,

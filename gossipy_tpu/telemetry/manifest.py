@@ -56,6 +56,35 @@ def git_revision(cwd: Optional[str] = None) -> Optional[str]:
     return rev if proc.returncode == 0 and rev else None
 
 
+def git_dirty(cwd: Optional[str] = None) -> Optional[bool]:
+    """Whether the checkout containing this package (or ``cwd``) has
+    uncommitted changes; None outside a repo / without git. Together
+    with :func:`git_revision` this is the ``code_version`` provenance
+    block ledger rows and flight-recorder bundles carry — a "regression"
+    reproduced from a dirty tree is not pinned to its recorded sha."""
+    if cwd is None:
+        import os
+        cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run(["git", "status", "--porcelain"],
+                              capture_output=True, text=True, timeout=10,
+                              cwd=cwd)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return bool(proc.stdout.strip())
+
+
+def code_version_block() -> Optional[dict]:
+    """``{"git_sha", "dirty"}`` or None outside a checkout — the one
+    provenance block stamped everywhere manifests are written."""
+    sha = git_revision()
+    if sha is None:
+        return None
+    return {"git_sha": sha, "dirty": git_dirty()}
+
+
 def _backend_info() -> dict:
     import jax
     try:
@@ -162,6 +191,11 @@ def _config_snapshot(sim: Any) -> dict:
         # the Tracer object; summary totals land in the manifest's
         # top-level ``trace`` block.
         snap["tracing"] = sim.tracer is not None
+    if hasattr(sim, "ledger"):
+        # Whether this run appended digest rows to a run ledger
+        # (telemetry.ledger) — excluded from the ledger's own config
+        # fingerprint, like the other host-observability toggles.
+        snap["ledger"] = sim.ledger is not None
     return snap
 
 
@@ -185,6 +219,7 @@ class RunManifest:
     backend: dict
     versions: dict
     git_rev: Optional[str] = None
+    code_version: Optional[dict] = None
     memory_budget: Optional[dict] = None
     mesh: Optional[dict] = None
     compile_seconds: Optional[float] = None
@@ -265,6 +300,7 @@ class RunManifest:
             backend=_backend_info(),
             versions=_versions(),
             git_rev=git_revision(),
+            code_version=code_version_block(),
             memory_budget=budget,
             mesh=_mesh_info(sim),
             compile_seconds=compile_seconds,
@@ -283,6 +319,7 @@ class RunManifest:
             "backend": self.backend,
             "versions": self.versions,
             "git_rev": self.git_rev,
+            "code_version": self.code_version,
             "memory_budget": self.memory_budget,
             "mesh": self.mesh,
             "compile_seconds": self.compile_seconds,

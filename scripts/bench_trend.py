@@ -3,7 +3,11 @@
 Reads every ``BENCH_r*.json`` driver capsule under ``--root`` (the
 ``{"n": …, "parsed": <bench row>}`` files the PR driver banks) plus any
 ``--row`` files (bare bench-row JSON — e.g. ``scripts/loadgen.py``'s
-``slo_row.json`` with the ``service_slo`` metric) and produces:
+``slo_row.json`` with the ``service_slo`` metric) plus, with
+``--ledger PATH``, the run ledger's bench-bearing rows
+(``telemetry.ledger``; deduplicated by run id and against the
+capsules, so a row that reached both sources never gates against
+itself) and produces:
 
 - a BASELINE.md-ready markdown trend table, one section per metric,
   rows grouped by backend (a CPU-degraded 44 r/s row must never be
@@ -72,6 +76,44 @@ def load_rows(root: str, extra: list) -> list:
                              "(no 'metric' field)")
         out.append({"source": os.path.basename(path),
                     "order": next_order, "row": row})
+        next_order += 1
+    return out
+
+
+def load_ledger_rows(path: str, entries: list) -> list:
+    """Fold a run ledger's bench-bearing rows (``bench_row`` payloads —
+    bench.py emits, loadgen SLO rows) in after the capsule/extra
+    entries, ordered by append time and deduplicated by run id against
+    nothing — ledger run ids are unique — and by exact row identity
+    against the capsules (a row that reached BOTH a BENCH_r capsule and
+    the ledger must not gate against itself)."""
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _repo not in sys.path:
+        sys.path.insert(0, _repo)
+    from gossipy_tpu.telemetry.ledger import RunLedger
+    doc = RunLedger(path).read()
+    if doc["skipped"]:
+        print(f"[trend] {path}: skipped {doc['skipped']} torn line(s)",
+              file=sys.stderr)
+    seen_rows = {json.dumps(e["row"], sort_keys=True) for e in entries}
+    seen_ids: set = set()
+    next_order = max((e["order"] for e in entries), default=0) + 1
+    ledger_rows = [r for r in doc["rows"]
+                   if isinstance(r.get("bench_row"), dict)
+                   and "metric" in r["bench_row"]]
+    ledger_rows.sort(key=lambda r: r.get("ts") or 0.0)
+    out = list(entries)
+    for r in ledger_rows:
+        rid = r.get("run_id")
+        if rid in seen_ids:
+            continue
+        seen_ids.add(rid)
+        canon = json.dumps(r["bench_row"], sort_keys=True)
+        if canon in seen_rows:
+            continue
+        seen_rows.add(canon)
+        out.append({"source": f"ledger:{rid}", "order": next_order,
+                    "row": r["bench_row"]})
         next_order += 1
     return out
 
@@ -156,6 +198,10 @@ def main() -> int:
     ap.add_argument("--row", action="append", default=[],
                     help="extra bench-row JSON file (repeatable), e.g. "
                          "loadgen's slo_row.json")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="run-ledger file (telemetry.ledger): fold its "
+                         "bench-bearing rows in alongside the capsules, "
+                         "deduplicated by run id")
     ap.add_argument("--out", default=None,
                     help="write the markdown table here (default: stdout)")
     ap.add_argument("--max-regress", type=float, default=0.15,
@@ -163,6 +209,8 @@ def main() -> int:
     args = ap.parse_args()
 
     entries = load_rows(args.root, args.row)
+    if args.ledger:
+        entries = load_ledger_rows(args.ledger, entries)
     table, regressions = analyze(entries, args.max_regress)
     if args.out:
         with open(args.out, "w") as fh:

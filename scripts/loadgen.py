@@ -70,6 +70,11 @@ def main() -> int:
     ap.add_argument("--metrics-dir", default=None,
                     help="metrics snapshot/export dir "
                          "(default: <out>/metrics)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="run-ledger file (telemetry.ledger): every "
+                         "finalized tenant appends a digest row and the "
+                         "service_slo row lands as the run's index entry "
+                         "(default: $GOSSIPY_TPU_LEDGER)")
     args = ap.parse_args()
 
     from gossipy_tpu import enable_compilation_cache
@@ -87,12 +92,16 @@ def main() -> int:
 
     from gossipy_tpu.telemetry.tracing import Tracer, trace_report
 
+    from gossipy_tpu.telemetry.ledger import ingest_slo_row, resolve_ledger
+
     metrics_dir = args.metrics_dir or os.path.join(args.out, "metrics")
     tracer = Tracer(process_name="loadgen")
+    ledger = resolve_ledger(args.ledger or None)
     result = run_load(args.out, pool=pool, n_tenants=args.tenants,
                       rate_per_hour=args.rate, seed=args.seed,
                       slice_rounds=args.slice, metrics_dir=metrics_dir,
-                      time_scale=args.time_scale, tracing=tracer)
+                      time_scale=args.time_scale, tracing=tracer,
+                      ledger=ledger)
     row, queue = result["row"], result["queue"]
 
     # Final trace + critical-path report: the session already refreshed
@@ -152,6 +161,20 @@ def main() -> int:
         json.dump(row, fh, indent=2)
         fh.write("\n")
     print(json.dumps(row))
+
+    if ledger is not None:
+        try:
+            # The run's index entry (telemetry.ledger): tenants/hour +
+            # SLO percentiles + the trace headline, with slo_row.json /
+            # trace_report.json as hashed artifacts. The per-tenant rows
+            # landed at each finalize above.
+            lrow = ingest_slo_row(ledger, row, artifacts={
+                "slo_row": row_path, "trace_report": report_path})
+            print(f"[loadgen] ledger: row {lrow['run_id']} -> "
+                  f"{ledger.path}", file=sys.stderr)
+        except Exception as e:
+            print(f"[loadgen] ledger ingest failed: {e!r}",
+                  file=sys.stderr)
 
     # Acceptance invariant: every admitted tenant has a recorded TTFR
     # and nothing failed outright.

@@ -420,6 +420,9 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-at", type=int, default=None, metavar="N",
                     help="inject an execution-time fault at rung N "
                          "(forensics self-test: the verdict must name it)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="run-ledger file to append one digest row per "
+                         "rung to (default: $GOSSIPY_TPU_LEDGER)")
     args = ap.parse_args(argv)
 
     if args.rungs:
@@ -518,6 +521,18 @@ def main(argv=None) -> int:
         fh.write(_markdown([r for r in rows if "predicted" in r], verdict))
     _stamp(f"wrote {path} and {md_path} "
            f"({len(rows)} rungs{'; VERDICT' if verdict else ''})")
+    try:
+        # Run-ledger ingest (telemetry.ledger): one digest row per rung
+        # plus a failure row for the verdict — opt-in via --ledger or
+        # the GOSSIPY_TPU_LEDGER env var, best-effort.
+        from gossipy_tpu.telemetry.ledger import (ingest_ladder,
+                                                  resolve_ledger)
+        led = resolve_ledger(args.ledger or None)
+        if led is not None:
+            n = len(ingest_ladder(led, out, path=path))
+            _stamp(f"ledger: {n} row(s) -> {led.path}")
+    except Exception as e:
+        _stamp(f"ledger ingest failed: {e!r}")
     return 1 if verdict else 0
 
 

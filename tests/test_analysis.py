@@ -269,6 +269,64 @@ class TestTraceInTrace:
         assert lint({"gossipy_tpu/_tfire.py": src}) == []
 
 
+LEDGER_IN_TRACE = '''
+import jax
+from .telemetry.ledger import resolve_ledger
+
+def body(carry, x):
+    resolve_ledger(None).append({"kind": "engine"})   # host sink!
+    return carry, x
+
+def drive(init):
+    return jax.lax.scan(body, init, None, length=2)
+'''
+
+LEDGER_HOST_OK = '''
+import jax
+from .telemetry.ledger import ingest_manifest, resolve_ledger
+
+def drive(sim, state, key):
+    # Post-run host append — the engine/_ledger_append contract.
+    state, rep = sim.start(state, n_rounds=2, key=key)
+    led = resolve_ledger(None)
+    if led is not None:
+        ingest_manifest(led, sim.run_manifest(), kind="engine")
+    return state, rep
+
+def step(carry, _):
+    def cb(v):
+        # io_callback body: host-side by contract — ledger calls OK.
+        resolve_ledger(None).append({"v": float(v)})
+    jax.experimental.io_callback(cb, None, carry, ordered=True)
+    return carry, ()
+
+def traced_drive(init):
+    return jax.lax.scan(step, init, None, length=2)
+'''
+
+
+class TestLedgerInTrace:
+    def test_fires_on_ledger_call_in_traced_region(self):
+        fs = lint({"gossipy_tpu/_lfire.py": LEDGER_IN_TRACE})
+        assert rules_of(fs) == ["ledger-in-trace"]
+        assert all(f.path == "gossipy_tpu/_lfire.py" for f in fs)
+        assert "host-side sink" in fs[0].message
+
+    def test_quiet_in_host_driver_and_io_callback(self):
+        assert lint({"gossipy_tpu/_lquiet.py": LEDGER_HOST_OK}) == []
+
+    def test_tree_is_clean(self):
+        # The standing invariant behind the engine/ledger-on HLO
+        # identity pair: every ledger append is post-run host code, so
+        # the real tree has zero ledger-in-trace findings.
+        assert [f for f in lint() if f.rule == "ledger-in-trace"] == []
+
+    def test_suppressible_like_any_rule(self):
+        src = LEDGER_IN_TRACE.replace(
+            "# host sink!", "# tracelint: disable=ledger-in-trace")
+        assert lint({"gossipy_tpu/_lfire.py": src}) == []
+
+
 class TestRegistryRules:
     def test_unregistered_per_round_field_is_flagged(self):
         eng_path = REPO / "gossipy_tpu" / "simulation" / "engine.py"

@@ -1,0 +1,711 @@
+"""Run-ledger tests (telemetry.ledger + scripts/ledger.py): CRC
+framing, kill-9 torn-tail recovery and repair, merge algebra
+(associative/commutative/idempotent), the ingest adapters, the
+engine/service opt-in contract, and the forensics CLI — ``diff`` must
+NAME the changed config field and metric delta, ``bisect`` must exit
+git-bisect-correct codes (0 good / 1 bad / 125 skip)."""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+from gossipy_tpu.telemetry.ledger import (
+    LEDGER_ENV,
+    LEDGER_SCHEMA,
+    RunLedger,
+    _frame,
+    config_fingerprint,
+    ingest_bench_capsule,
+    ingest_bundle,
+    ingest_ladder,
+    ingest_manifest,
+    ingest_slo_row,
+    ingest_trace_report,
+    merge_ledger_files,
+    merge_ledgers,
+    resolve_ledger,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def load_script(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ledger_cli = load_script("ledger")
+
+
+def make_ledger(tmp_path, name="ledger.jsonl") -> RunLedger:
+    return RunLedger(str(tmp_path / name))
+
+
+# ---------------------------------------------------------------------------
+# Framing + crash safety (the tentpole contract)
+
+
+class TestFraming:
+    def test_append_read_roundtrip(self, tmp_path):
+        led = make_ledger(tmp_path)
+        r1 = led.append({"kind": "engine", "metrics": {"x": 1.5}})
+        r2 = led.append({"kind": "bench"})
+        doc = led.read()
+        assert doc["skipped"] == 0
+        assert [r["kind"] for r in doc["rows"]] == ["engine", "bench"]
+        # Stamps: schema, a 12-hex run id, a wall timestamp.
+        for row in (r1, r2):
+            assert row["schema"] == LEDGER_SCHEMA
+            assert len(row["run_id"]) == 12
+            assert isinstance(row["ts"], float)
+        assert doc["rows"][0]["metrics"] == {"x": 1.5}
+        assert r1["run_id"] != r2["run_id"]
+
+    def test_explicit_run_id_and_ts_preserved(self, tmp_path):
+        led = make_ledger(tmp_path)
+        led.append({"kind": "engine", "run_id": "abc123", "ts": 7.0})
+        row = led.rows()[0]
+        assert row["run_id"] == "abc123" and row["ts"] == 7.0
+        assert led.find("abc") == [row] and led.find("zzz") == []
+
+    def test_corrupt_byte_skipped_not_fatal(self, tmp_path):
+        led = make_ledger(tmp_path)
+        led.append({"kind": "a"})
+        led.append({"kind": "b"})
+        data = bytearray(open(led.path, "rb").read())
+        # Flip one payload byte of the FIRST line: its CRC fails, the
+        # second line still reads.
+        data[12] ^= 0xFF
+        open(led.path, "wb").write(bytes(data))
+        doc = led.read()
+        assert doc["skipped"] == 1
+        assert [r["kind"] for r in doc["rows"]] == ["b"]
+
+    def test_non_dict_payload_skipped(self, tmp_path):
+        led = make_ledger(tmp_path)
+        led.append({"kind": "a"})
+        with open(led.path, "ab") as fh:
+            fh.write(_frame("[1,2,3]"))   # valid CRC, wrong shape
+        doc = led.read()
+        assert doc["skipped"] == 1 and len(doc["rows"]) == 1
+
+
+class TestCrashSafety:
+    def test_torn_tail_skipped_then_repaired_by_next_append(self, tmp_path):
+        """The acceptance fixture: a file truncated mid-record reads back
+        every complete row, and the NEXT append repairs the tail."""
+        led = make_ledger(tmp_path)
+        led.append({"kind": "a"})
+        led.append({"kind": "b"})
+        with open(led.path, "ab") as fh:       # kill -9 mid-append
+            fh.write(b'deadbeef {"kind": "torn", "metr')
+        doc = led.read()
+        assert doc["skipped"] == 1
+        assert [r["kind"] for r in doc["rows"]] == ["a", "b"]
+        led.append({"kind": "c"})              # repairs, then writes
+        doc = led.read()
+        assert doc["skipped"] == 0
+        assert [r["kind"] for r in doc["rows"]] == ["a", "b", "c"]
+        raw = open(led.path, "rb").read()
+        assert b"torn" not in raw and raw.endswith(b"\n")
+
+    def test_truncated_final_record(self, tmp_path):
+        led = make_ledger(tmp_path)
+        for k in ("a", "b", "c"):
+            led.append({"kind": k})
+        size = os.path.getsize(led.path)
+        with open(led.path, "rb+") as fh:      # torn inside row "c"
+            fh.truncate(size - 7)
+        doc = led.read()
+        assert doc["skipped"] == 1
+        assert [r["kind"] for r in doc["rows"]] == ["a", "b"]
+        led.append({"kind": "d"})
+        doc = led.read()
+        assert doc["skipped"] == 0
+        assert [r["kind"] for r in doc["rows"]] == ["a", "b", "d"]
+
+    def test_missing_file_is_empty_and_parents_created(self, tmp_path):
+        led = RunLedger(str(tmp_path / "deep" / "nested" / "l.jsonl"))
+        assert led.read() == {"rows": [], "skipped": 0}
+        led.append({"kind": "a"})
+        assert len(led.rows()) == 1
+
+    def test_concurrent_appends_never_tear(self, tmp_path):
+        led = make_ledger(tmp_path)
+
+        def work(i):
+            for j in range(10):
+                led.append({"kind": "t", "i": i, "j": j})
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        doc = led.read()
+        assert doc["skipped"] == 0 and len(doc["rows"]) == 40
+
+
+# ---------------------------------------------------------------------------
+# Merge algebra (the fleet-wide index; satellite 4)
+
+
+class TestMergeAlgebra:
+    @pytest.fixture()
+    def abc(self, tmp_path):
+        out = []
+        for name, kinds in (("a", ("a1", "a2")), ("b", ("b1",)),
+                            ("c", ("c1", "c2", "c3"))):
+            led = make_ledger(tmp_path, f"{name}.jsonl")
+            for k in kinds:
+                led.append({"kind": k})
+            out.append(led.rows())
+        return out
+
+    def test_three_way_associative(self, abc):
+        a, b, c = abc
+        assert merge_ledgers(merge_ledgers(a, b), c) == \
+            merge_ledgers(a, merge_ledgers(b, c))
+
+    def test_commutative(self, abc):
+        a, b, c = abc
+        assert merge_ledgers(a, b) == merge_ledgers(b, a)
+        assert merge_ledgers(merge_ledgers(c, a), b) == \
+            merge_ledgers(merge_ledgers(b, c), a)
+
+    def test_idempotent(self, abc):
+        a, _, _ = abc
+        merged = merge_ledgers(a, a)
+        assert merged == merge_ledgers(a, [])   # self-union is a no-op
+        assert len(merged) == len(a)
+        assert merge_ledgers(merged, a) == merged
+
+    def test_schema_mismatch_raises(self, abc):
+        a, b, _ = abc
+        drifted = [dict(b[0], schema=LEDGER_SCHEMA + 1)]
+        with pytest.raises(ValueError, match="schema"):
+            merge_ledgers(a, drifted)
+
+    def test_merge_files_atomic_and_readable(self, tmp_path, abc):
+        paths = [str(tmp_path / f"{n}.jsonl") for n in "abc"]
+        out = str(tmp_path / "fleet.jsonl")
+        n = merge_ledger_files(out, paths)
+        assert n == 6
+        doc = RunLedger(out).read()
+        assert doc["skipped"] == 0 and len(doc["rows"]) == 6
+        # Folding the merged file back in changes nothing (idempotent).
+        assert merge_ledger_files(out, [out] + paths) == 6
+
+
+class TestFingerprint:
+    def test_observability_knobs_excluded(self):
+        base = {"n_nodes": 8, "delta": 10}
+        noisy = dict(base, tracing=True, metrics={"x": 1}, perf=True,
+                     ledger=True, partition_rules=["r"])
+        assert config_fingerprint(base) == config_fingerprint(noisy)
+
+    def test_real_field_changes_it(self):
+        assert config_fingerprint({"n_nodes": 8}) != \
+            config_fingerprint({"n_nodes": 9})
+
+    def test_key_order_stable_and_none_safe(self):
+        assert config_fingerprint({"a": 1, "b": 2}) == \
+            config_fingerprint({"b": 2, "a": 1})
+        assert config_fingerprint(None) is None
+
+
+# ---------------------------------------------------------------------------
+# Ingest adapters — one per producer
+
+
+class TestAdapters:
+    def test_bench_capsule_forms(self, tmp_path):
+        led = make_ledger(tmp_path)
+        row = {"metric": "rounds_per_sec", "value": 123.0,
+               "unit": "rounds/s",
+               "raw": {"backend": "cpu", "degraded": True,
+                       "degrade_reason": "cpu fallback",
+                       "host_blocked_frac": 0.25, "n_nodes": 64}}
+        r1 = ingest_bench_capsule(led, row)                  # bare row
+        capsule_path = tmp_path / "BENCH_r3.json"
+        capsule_path.write_text(json.dumps({"n": 3, "parsed": row}))
+        r2 = ingest_bench_capsule(led, str(capsule_path))    # file path
+        assert r1["kind"] == r2["kind"] == "bench"
+        assert r1["metrics"]["rounds_per_sec"] == 123.0
+        assert r1["metrics"]["host_blocked_frac"] == 0.25
+        assert r1["degraded"] is True
+        assert r1["failure"]["reason"] == "cpu fallback"
+        assert r1["bench_row"] == row                        # lossless
+        assert r2["source"] == "BENCH_r3.json"
+        assert r1["config"]["n_nodes"] == 64
+
+    def test_ladder_rungs_and_verdict(self, tmp_path):
+        led = make_ledger(tmp_path)
+        ladder = {"backend": "cpu", "device_kind": "cpu",
+                  "rungs": [
+                      {"n_nodes": 1024, "cohort_size": 64,
+                       "measured": {"ms_per_round": 50.0,
+                                    "mfu_est": 0.1}},
+                      {"n_nodes": 4096, "failed": True, "measured": {}},
+                  ],
+                  "verdict": {"kind": "oom", "rung": 4096}}
+        rows = ingest_ladder(led, ladder)
+        assert [r["kind"] for r in rows] == \
+            ["ladder_rung", "ladder_rung", "ladder_verdict"]
+        assert rows[0]["metrics"]["rounds_per_sec"] == 20.0
+        assert rows[0]["config_fingerprint"]
+        assert rows[1]["failure"] == {"kind": "rung_failed"}
+        assert rows[2]["failure"]["kind"] == "oom"
+
+    def test_slo_row(self, tmp_path):
+        led = make_ledger(tmp_path)
+        row = {"metric": "service_slo", "value": 120.0,
+               "unit": "tenants/hour",
+               "raw": {"ttfr_p50_ms": 80.0, "ttfr_p99_ms": 450.0,
+                       "n_admitted": 6, "backend": "cpu"}}
+        out = ingest_slo_row(led, row)
+        assert out["kind"] == "loadgen"
+        assert out["metrics"]["slo_p99_ms"] == 450.0
+        assert out["bench_row"] == row
+        assert out["config"]["n_admitted"] == 6
+
+    def test_bundle_failure_row(self, tmp_path):
+        bundle = tmp_path / "bundle"
+        bundle.mkdir()
+        (bundle / "verdict.json").write_text(
+            json.dumps({"kind": "nonfinite", "round": 7}))
+        (bundle / "manifest.json").write_text(json.dumps(
+            {"backend": {"backend": "cpu"},
+             "config": {"n_nodes": 4, "partition_rules": ["x"]}}))
+        led = make_ledger(tmp_path)
+        row = ingest_bundle(led, str(bundle))
+        assert row["kind"] == "bundle"
+        assert row["failure"]["kind"] == "nonfinite"
+        assert row["failure"]["verdict"]["round"] == 7
+        assert row["config"] == {"n_nodes": 4}   # rules stripped
+        assert row["config_fingerprint"]
+        assert row["artifacts"]["verdict"]["sha256"]
+
+    def test_trace_report(self, tmp_path):
+        led = make_ledger(tmp_path)
+        report = {"totals": {"host_blocked_frac": 0.2,
+                             "overlap_frac": 0.5, "wall_ms": 10.0},
+                  "n_windows": 2}
+        row = ingest_trace_report(led, report, run_id="tr0")
+        assert row["kind"] == "trace" and row["run_id"] == "tr0"
+        assert row["metrics"] == {"host_blocked_frac": 0.2,
+                                  "overlap_frac": 0.5}
+        assert row["extra"]["n_windows"] == 2
+
+    def test_manifest_artifacts_hashed(self, tmp_path):
+        led = make_ledger(tmp_path)
+        art = tmp_path / "report.json"
+        art.write_text("{}")
+        row = ingest_manifest(
+            led, {"config": {"n_nodes": 8}, "backend": {"backend": "cpu"}},
+            artifacts={"report": str(art),
+                       "gone": str(tmp_path / "missing.json")})
+        assert row["artifacts"]["report"]["sha256"]
+        assert len(row["artifacts"]["report"]["sha256"]) == 16
+        assert row["artifacts"]["gone"]["sha256"] is None
+        assert row["degraded"] is True    # cpu backend
+        # NaN metrics are "not measured", never stored.
+        row2 = ingest_manifest(
+            led, {"config": {}}, metrics={"final_accuracy": float("nan"),
+                                          "mfu_est": None, "ok": 1})
+        assert row2["metrics"] == {"ok": 1.0}
+
+
+class TestResolveContract:
+    def test_none_consults_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(LEDGER_ENV, raising=False)
+        assert resolve_ledger(None) is None
+        path = str(tmp_path / "env.jsonl")
+        monkeypatch.setenv(LEDGER_ENV, path)
+        led = resolve_ledger(None)
+        assert isinstance(led, RunLedger) and led.path == path
+
+    def test_false_is_strictly_off(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(LEDGER_ENV, str(tmp_path / "env.jsonl"))
+        assert resolve_ledger(False) is None
+
+    def test_path_and_instance_passthrough(self, tmp_path):
+        path = str(tmp_path / "x.jsonl")
+        led = resolve_ledger(path)
+        assert isinstance(led, RunLedger)
+        assert resolve_ledger(led) is led
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring (tentpole ingest point #1) + satellite 1 (code_version)
+
+
+def make_dataset(n_nodes, seed=0):
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=6)
+    X = rng.normal(size=(20 * n_nodes, 6)).astype(np.float32)
+    y = (2 * (X @ w > 0) - 1).astype(np.float32)
+    dh = ClassificationDataHandler(X, y, test_size=0.25, seed=1)
+    return DataDispatcher(dh, n=n_nodes)
+
+
+def small_sim(n_nodes=16, **kwargs):
+    from gossipy_tpu.core import (AntiEntropyProtocol, CreateModelMode,
+                                  Topology)
+    from gossipy_tpu.handlers import PegasosHandler
+    from gossipy_tpu.models import AdaLine
+    from gossipy_tpu.simulation import GossipSimulator
+    handler = PegasosHandler(AdaLine(6), learning_rate=0.01,
+                             create_model_mode=CreateModelMode.UPDATE)
+    return GossipSimulator(handler, Topology.clique(n_nodes),
+                           make_dataset(n_nodes).stacked(), delta=5,
+                           protocol=AntiEntropyProtocol.PUSH, **kwargs)
+
+
+class TestEngineLedger:
+    def test_one_row_per_start_sharing_run_id(self, tmp_path, key):
+        led = make_ledger(tmp_path)
+        sim = small_sim(ledger=led)
+        st = sim.init_nodes(key)
+        st, _ = sim.start(st, n_rounds=3, key=key)
+        st, _ = sim.start(st, n_rounds=2, key=key)
+        doc = led.read()
+        assert doc["skipped"] == 0 and len(doc["rows"]) == 2
+        r1, r2 = doc["rows"]
+        assert r1["kind"] == r2["kind"] == "engine"
+        # Chunked-run continuity: both segments carry ONE run id.
+        assert r1["run_id"] == r2["run_id"]
+        assert r1["extra"]["rounds"] == 3 and r2["extra"]["rounds"] == 2
+        # Same sim, same config: the fingerprint is stable and pinned.
+        assert r1["config_fingerprint"] == r2["config_fingerprint"]
+        assert r1["config"]["n_nodes"] == 16
+        assert "partition_rules" not in r1["config"]
+
+    def test_env_opt_in_and_false_override(self, tmp_path, key,
+                                           monkeypatch):
+        path = str(tmp_path / "env.jsonl")
+        monkeypatch.setenv(LEDGER_ENV, path)
+        sim = small_sim()                      # ledger=None -> env
+        st = sim.init_nodes(key)
+        sim.start(st, n_rounds=2, key=key)
+        assert len(RunLedger(path).rows()) == 1
+        off = small_sim(ledger=False)          # strictly off
+        assert off.ledger is None
+
+    def test_manifest_carries_code_version_and_ledger_flag(self, tmp_path,
+                                                           key):
+        # Satellite 1: the RunManifest pins {git_sha, dirty} null-safely,
+        # and the config snapshot records whether a ledger was attached.
+        sim = small_sim(ledger=make_ledger(tmp_path))
+        man = sim.run_manifest().to_dict()
+        cv = man.get("code_version")
+        assert cv is not None and set(cv) == {"git_sha", "dirty"}
+        assert cv["git_sha"] == man["git_rev"]
+        assert isinstance(cv["dirty"], bool)
+        assert man["config"]["ledger"] is True
+        assert small_sim().run_manifest().to_dict()["config"]["ledger"] \
+            is False
+
+    def test_ledger_identity_pair_registered(self):
+        # The HLO gate's identity matrix proves ledger-on compiles the
+        # same bytes as ledger-off (host-sink contract).
+        from gossipy_tpu.analysis.hlo import gate_cases
+        names = {case[0] for case in gate_cases()["identity"]}
+        assert "engine/ledger-on" in names
+
+
+@pytest.mark.slow
+class TestLedgerHLOIdentity:
+    def test_ledger_on_is_byte_identical(self, tmp_path):
+        from gossipy_tpu.analysis import assert_identical_hlo
+        from gossipy_tpu.analysis.hlo import _make_sim
+        assert_identical_hlo(
+            _make_sim(),
+            _make_sim(ledger=RunLedger(str(tmp_path / "l.jsonl"))),
+            label="engine/ledger-on")
+
+
+# ---------------------------------------------------------------------------
+# Service wiring: continuous tenant accounting across scheduler restarts
+
+
+def tenant_data(seed, n=240, d=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.int64)
+    return X, y
+
+
+def service_cfg(**over):
+    from gossipy_tpu.config import ExperimentConfig
+    base = dict(n_nodes=16, model="logreg", handler="sgd",
+                topology="random_regular", topology_params={"degree": 4},
+                delta=20, n_rounds=6, batch_size=8)
+    base.update(over)
+    return ExperimentConfig(**base)
+
+
+class TestServiceLedgerContinuity:
+    def test_two_scheduler_sessions_one_ledger(self, tmp_path):
+        """Acceptance: tenants served by TWO GossipService instances
+        (a restart) land in ONE continuous ledger, each row replayable
+        via its pinned experiment config."""
+        from gossipy_tpu.config import ExperimentConfig
+        from gossipy_tpu.service import GossipService, RunQueue, RunRequest
+        path = str(tmp_path / "service.jsonl")
+
+        for session, (tenant, seed) in enumerate(
+                [("alice", 1), ("bob", 2)]):
+            q = RunQueue()
+            q.submit(RunRequest(tenant, service_cfg(seed=seed),
+                                data=tenant_data(seed)))
+            svc = GossipService(str(tmp_path / f"out{session}"),
+                                slice_rounds=4, ledger=path)
+            svc.serve(q)
+
+        doc = RunLedger(path).read()
+        assert doc["skipped"] == 0
+        tenant_rows = [r for r in doc["rows"] if r["kind"] == "tenant"]
+        assert {r["extra"]["tenant"] for r in tenant_rows} == \
+            {"alice", "bob"}
+        for r in tenant_rows:
+            assert r["extra"]["status"] == "done"
+            assert r["extra"]["rounds_completed"] == 6
+            # The pinned config round-trips into a replayable object —
+            # what `ledger bisect` feeds run_experiment.
+            cfg = ExperimentConfig.from_dict(dict(r["experiment"]))
+            assert cfg.n_nodes == 16
+            assert "report" in r["artifacts"]
+            assert r["artifacts"]["report"]["sha256"]
+
+
+# ---------------------------------------------------------------------------
+# Forensics CLI: list / show / diff / trend / merge
+
+
+@pytest.fixture(scope="module")
+def forensic(tmp_path_factory):
+    """Two real engine runs differing in ONE config field (drop_prob),
+    reports saved as linked artifacts — the regression-forensics e2e
+    fixture."""
+    from gossipy_tpu.config import ExperimentConfig, run_experiment
+    out = tmp_path_factory.mktemp("forensic")
+    data = tenant_data(0, n=240, d=6)
+    cfg_a = ExperimentConfig(n_nodes=8, topology="ring",
+                             topology_params={"k": 2}, delta=10,
+                             batch_size=8, learning_rate=0.5, n_rounds=8)
+    cfg_b = dataclasses.replace(cfg_a, drop_prob=0.5)
+    led = RunLedger(str(out / "ledger.jsonl"))
+    accs = {}
+    for name, cfg in (("a", cfg_a), ("b", cfg_b)):
+        _, report = run_experiment(cfg, data=data)
+        rpath = str(out / f"report_{name}.json")
+        report.save(rpath)
+        accs[name] = float(report.final("accuracy"))
+        ingest_manifest(
+            led, {"config": dataclasses.asdict(cfg),
+                  "backend": {"backend": "cpu", "device_kind": "cpu"}},
+            run_id=f"run{name * 3}000",
+            metrics={"final_accuracy": accs[name]},
+            artifacts={"report": rpath},
+            experiment=dataclasses.asdict(cfg))
+    return {"path": led.path, "out": str(out), "accs": accs}
+
+
+class TestForensicsCLI:
+    def test_list_renders_and_filters(self, forensic, tmp_path):
+        out = str(tmp_path / "list.md")
+        assert ledger_cli.main(["list", forensic["path"],
+                                "--out", out]) == 0
+        text = open(out).read()
+        assert "| run id |" in text and "runaaa000" in text
+        assert "2 row(s)" in text
+        assert ledger_cli.main(["list", forensic["path"], "--json",
+                                "--kind", "engine", "--out", out]) == 0
+        assert len(json.load(open(out))) == 2
+        assert ledger_cli.main(["list", forensic["path"], "--json",
+                                "--kind", "loadgen", "--out", out]) == 0
+        assert json.load(open(out)) == []
+
+    def test_show_resolves_prefix_and_index(self, forensic, capsys):
+        assert ledger_cli.main(["show", forensic["path"], "runaaa"]) == 0
+        assert json.loads(capsys.readouterr().out)["run_id"] == \
+            "runaaa000"
+        assert ledger_cli.main(["show", forensic["path"], "@-1"]) == 0
+        assert json.loads(capsys.readouterr().out)["run_id"] == \
+            "runbbb000"
+        with pytest.raises(SystemExit, match="no row"):
+            ledger_cli.main(["show", forensic["path"], "nope"])
+
+    def test_diff_names_config_field_and_metric_delta(self, forensic):
+        """THE acceptance check: the diff names the changed config field
+        (drop_prob 0.0 -> 0.5), the final_accuracy delta, and — from the
+        linked reports — the first divergent round."""
+        rows = RunLedger(forensic["path"]).rows()
+        d = ledger_cli.diff_rows(rows[0], rows[1])
+        assert d["config_diff"] == {
+            "drop_prob": {"a": 0.0, "b": 0.5}}
+        assert d["fingerprint_changed"] is True
+        acc = d["metric_deltas"]["final_accuracy"]
+        assert acc["a"] == forensic["accs"]["a"]
+        assert acc["b"] == forensic["accs"]["b"]
+        assert acc["delta"] == pytest.approx(acc["b"] - acc["a"])
+        # Half the messages dropped: the runs' per-round accounting
+        # diverges, and the diff says where.
+        fdr = d["first_divergent_round"]
+        assert isinstance(fdr, int) and 1 <= fdr <= 8
+
+    def test_diff_cli_expect_config_diff(self, forensic, tmp_path,
+                                         capsys):
+        assert ledger_cli.main(
+            ["diff", forensic["path"], "@0", "@1",
+             "--expect-config-diff"]) == 0
+        out = capsys.readouterr().out
+        assert "drop_prob: 0.0 -> 0.5" in out
+        assert "fingerprint CHANGED" in out
+        # Two rows with IDENTICAL config: the CI assertion trips.
+        led = make_ledger(tmp_path, "same.jsonl")
+        for _ in range(2):
+            led.append({"kind": "engine", "config": {"n_nodes": 8}})
+        assert ledger_cli.main(["diff", led.path, "@0", "@1",
+                                "--expect-config-diff"]) == 1
+
+    def test_diff_json_round_trips(self, forensic, capsys):
+        assert ledger_cli.main(["diff", forensic["path"], "@0", "@1",
+                                "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert "drop_prob" in d["config_diff"]
+
+    def test_trend_gates_on_regression(self, tmp_path, capsys):
+        led = make_ledger(tmp_path)
+        for v, ts in ((100.0, 1.0), (45.0, 2.0)):   # 55% drop
+            led.append({"kind": "bench", "ts": ts, "backend": "cpu",
+                        "metrics": {"rounds_per_sec": v}})
+        assert ledger_cli.main(["trend", led.path, "--metric",
+                                "rounds_per_sec"]) == 1
+        capsys.readouterr()
+        # Within budget: 10% drop passes the default 15% gate.
+        led2 = make_ledger(tmp_path, "ok.jsonl")
+        for v, ts in ((100.0, 1.0), (90.0, 2.0)):
+            led2.append({"kind": "bench", "ts": ts, "backend": "cpu",
+                         "metrics": {"rounds_per_sec": v}})
+        assert ledger_cli.main(["trend", led2.path, "--metric",
+                                "rounds_per_sec"]) == 0
+
+    def test_merge_cli(self, forensic, tmp_path):
+        led2 = make_ledger(tmp_path, "other.jsonl")
+        led2.append({"kind": "bench"})
+        out = str(tmp_path / "merged.jsonl")
+        assert ledger_cli.main(["merge", out, forensic["path"],
+                                led2.path]) == 0
+        assert len(RunLedger(out).rows()) == 3
+
+
+# ---------------------------------------------------------------------------
+# Bisect: git-bisect-correct exit codes over real replays
+
+
+@pytest.fixture(scope="module")
+def bisect_ledger(tmp_path_factory):
+    """A baseline row with a RECORDED final_accuracy from a real run,
+    plus replayable rows: one pinning the same (good) config, one
+    pinning a config with learning disabled (the seeded regression),
+    one with no experiment at all."""
+    from gossipy_tpu.config import ExperimentConfig, run_experiment
+    out = tmp_path_factory.mktemp("bisect")
+    cfg_good = ExperimentConfig(dataset="breast", n_nodes=8,
+                                topology="ring", topology_params={"k": 2},
+                                delta=10, batch_size=8,
+                                learning_rate=0.5, n_rounds=8)
+    _, report = run_experiment(cfg_good)
+    acc = float(report.final("accuracy"))
+    assert acc > 0.8, f"good config failed to learn (acc={acc})"
+    led = RunLedger(str(out / "ledger.jsonl"))
+    led.append({"kind": "engine", "run_id": "base00000000",
+                "metrics": {"final_accuracy": acc},
+                "experiment": dataclasses.asdict(cfg_good)})
+    led.append({"kind": "engine", "run_id": "good00000000",
+                "experiment": dataclasses.asdict(cfg_good)})
+    cfg_bad = dataclasses.replace(cfg_good, learning_rate=0.0)
+    led.append({"kind": "engine", "run_id": "bad000000000",
+                "experiment": dataclasses.asdict(cfg_bad)})
+    led.append({"kind": "engine", "run_id": "noexp0000000",
+                "metrics": {"final_accuracy": 0.9}})
+    return led.path
+
+
+class TestBisect:
+    def test_good_replay_exits_zero(self, bisect_ledger, capsys):
+        rc = ledger_cli.main(["bisect", bisect_ledger, "good",
+                              "--baseline", "base",
+                              "--metric", "final_accuracy"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["verdict"] == "good"
+
+    def test_regression_replay_exits_one(self, bisect_ledger, capsys):
+        rc = ledger_cli.main(["bisect", bisect_ledger, "bad",
+                              "--baseline", "base",
+                              "--metric", "final_accuracy"])
+        assert rc == 1
+        assert json.loads(capsys.readouterr().out)["verdict"] == "BAD"
+
+    def test_unreplayable_rows_exit_skip(self, bisect_ledger):
+        # git bisect's skip code (125) — never a false good/bad verdict.
+        assert ledger_cli.main(
+            ["bisect", bisect_ledger, "noexp", "--baseline", "base",
+             "--metric", "final_accuracy"]) == 125
+        assert ledger_cli.main(
+            ["bisect", bisect_ledger, "good", "--baseline", "noexp",
+             "--metric", "rounds_per_sec"]) == 125
+        assert ledger_cli.main(
+            ["bisect", bisect_ledger, "missing", "--baseline", "base",
+             "--metric", "final_accuracy"]) == 125
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: bench_trend --ledger folding
+
+
+class TestBenchTrendLedger:
+    def test_folds_dedupes_and_orders(self, tmp_path):
+        bt = load_script("bench_trend")
+        led = make_ledger(tmp_path)
+        row1 = {"metric": "rounds_per_sec", "value": 100.0,
+                "unit": "rounds/s", "raw": {"backend": "cpu"}}
+        row2 = {"metric": "rounds_per_sec", "value": 90.0,
+                "unit": "rounds/s", "raw": {"backend": "cpu"}}
+        ingest_bench_capsule(led, {"n": 1, "parsed": row1})
+        ingest_bench_capsule(led, row2)
+        # row1 also reached a BENCH_r capsule: it must NOT fold twice
+        # (a row gating against itself would always "regress" 0%).
+        entries = [{"source": "BENCH_r1.json", "order": 1, "row": row1}]
+        out = bt.load_ledger_rows(led.path, entries)
+        assert len(out) == 2
+        assert out[0]["source"] == "BENCH_r1.json"
+        assert out[1]["source"].startswith("ledger:")
+        assert out[1]["row"] == row2
+        assert out[1]["order"] > out[0]["order"]
+        # Folding again is a no-op (run-id + identity dedup).
+        assert len(bt.load_ledger_rows(led.path, out)) == 2
+
+    def test_torn_ledger_never_breaks_trend(self, tmp_path):
+        bt = load_script("bench_trend")
+        led = make_ledger(tmp_path)
+        ingest_bench_capsule(
+            led, {"metric": "rounds_per_sec", "value": 50.0,
+                  "unit": "rounds/s", "raw": {}})
+        with open(led.path, "ab") as fh:
+            fh.write(b"deadbeef {torn")
+        out = bt.load_ledger_rows(led.path, [])
+        assert len(out) == 1 and out[0]["row"]["value"] == 50.0
