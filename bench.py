@@ -1246,14 +1246,55 @@ def _attention_parity(dense_fn, flash_fn, q, k, v,
     }
 
 
+def _deliver_phase_ms(sim, state, key, rounds: int):
+    """Deliver-phase (``gossipy.receive_merge``) milliseconds per round
+    from a profiler trace of ``rounds`` rounds — the direct per-phase
+    signal (telemetry.cost), not a wall-clock difference. None when the
+    runtime's trace carries no attributable phase durations."""
+    import tempfile
+
+    import jax
+
+    from gossipy_tpu.telemetry import phase_times_from_trace
+    from gossipy_tpu.telemetry.cost import hlo_op_phases
+    from gossipy_tpu.telemetry.scopes import PHASE_RECEIVE_MERGE
+
+    tmp = tempfile.mkdtemp(prefix="fused_deliver_trace_")
+    try:
+        tracer = jax.profiler.trace(tmp, create_perfetto_trace=True)
+    except TypeError:  # older jax without the kwarg
+        tracer = jax.profiler.trace(tmp)
+    with tracer:
+        s, _ = sim.start(state, n_rounds=rounds, key=key, donate_state=False)
+        jax.block_until_ready(s.model.params)
+    # CPU-runtime traces carry bare HLO op names; bridge through the
+    # compiled program's own op_name scope metadata (TPU XProf dumps match
+    # on the scope string directly and the map is a harmless no-op).
+    try:
+        op_map = hlo_op_phases(
+            sim.lower_start(state, n_rounds=rounds, key=key)
+            .compile().as_text())
+    except Exception:
+        op_map = None
+    per_phase = phase_times_from_trace(tmp, op_to_phase=op_map)
+    if per_phase is None or PHASE_RECEIVE_MERGE not in per_phase:
+        return None
+    return per_phase[PHASE_RECEIVE_MERGE] / rounds
+
+
 def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
     """Pallas ``fused_merge`` in its design regime: CNN-sized params, clique
-    fan-in (every mailbox slot regularly occupied), MERGE_UPDATE deliver.
+    fan-in with a K=4 mailbox, MERGE_UPDATE deliver.
 
-    Round 1 measured the kernel level with XLA on the 20-regular spambase
-    config (254 vs 247 ms/round); this mode answers whether the kernel wins
-    where the gather materialization actually dominates, or should be
-    retired to documentation. Prints ONE JSON line with both timings.
+    Three legs — plain (XLA gather+blend), ``per_slot`` (one kernel launch
+    per mailbox slot, the pre-multi fused path kept for exactly this A/B)
+    and ``multi`` (one launch drains all K slots). Wall-clock speedup is a
+    TPU measurement (interpreter-mode wall clock is meaningless and the
+    legs are skipped off-TPU, as before); the DELIVER-PHASE ms from
+    ``phase_times_from_trace`` and the bytes-moved model are stamped on
+    every backend — on CPU the interpreter runs the same launch schedule,
+    so per_slot vs multi is a meaningful relative row (the K->1 launch
+    collapse) even where absolute numbers are not. Prints ONE JSON line.
     ``n`` overrides the node count (smoke tests only).
     """
     import jax
@@ -1268,11 +1309,12 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
 
     # The degraded CPU fallback cannot afford the full clique-64 CNN
     # measurement (fp32 CNN rounds on this 1-core host are ~0.5 s each and
-    # the mode compiles + times TWO simulators); shrink it — the run is
-    # labeled degraded and the fused leg is skipped off-TPU anyway, so only
-    # a finite plain ms/round matters.
+    # the mode compiles + traces THREE simulators); shrink it — the run is
+    # labeled degraded and the wall-clock fused legs are skipped off-TPU,
+    # so only finite plain/deliver numbers matter.
     if DEGRADED:
         rounds, n = min(rounds, 4), min(n, 16)
+    K = 4  # mailbox depth: the K->1 launch collapse under measurement
     rng = np.random.default_rng(0)
     Xtr = rng.normal(size=(n * 64, 32, 32, 3)).astype(np.float32)
     ytr = rng.integers(0, 10, n * 64)
@@ -1283,46 +1325,134 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
         optimizer=optax.sgd(0.05), local_epochs=1, batch_size=32,
         n_classes=10, input_shape=(32, 32, 3),
         create_model_mode=CreateModelMode.MERGE_UPDATE,
-        # bf16 is the TPU measurement dtype; on CPU (smoke only — the fused
-        # run is skipped there anyway) bf16 is emulated and ~10x slower.
+        # bf16 is the TPU measurement dtype; on CPU (smoke only — the
+        # wall-clock fused runs are skipped there anyway) bf16 is emulated
+        # and ~10x slower.
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
         else None)
+    legs = {False: "plain", "per_slot": "per_slot", "multi": "multi"}
 
-    def run(fused: bool) -> float:
+    def make_sim(fused):
         # perf=True on the plain leg: the row's uniform perf trio
         # (raw.mfu_est / flops_per_round / hbm_peak_bytes) comes from
         # the same config the plain timing measured.
-        sim = GossipSimulator(handler, Topology.clique(n), disp.stacked(),
-                              delta=ROUND_LEN,
-                              protocol=AntiEntropyProtocol.PUSH,
-                              eval_every=rounds, fused_merge=fused,
-                              perf=not fused)
+        return GossipSimulator(handler, Topology.clique(n), disp.stacked(),
+                               delta=ROUND_LEN,
+                               protocol=AntiEntropyProtocol.PUSH,
+                               eval_every=rounds, fused_merge=fused,
+                               mailbox_slots=K, perf=fused is False)
+
+    accepted: dict = {}
+
+    def run(fused) -> float:
+        sim = make_sim(fused)
         key = jax.random.PRNGKey(0)
         state = sim.init_nodes(key, common_init=True)
         s2, _ = sim.start(state, n_rounds=rounds, key=key,  # compile
                           donate_state=False)
         jax.block_until_ready(s2.model.params)
         t0 = time.perf_counter()
-        s3, _ = sim.start(state, n_rounds=rounds, key=key)
+        s3, rep = sim.start(state, n_rounds=rounds, key=key)
         jax.block_until_ready(s3.model.params)
-        if not fused:
+        accepted[legs[fused]] = (rep.sent_messages - rep.failed_messages) \
+            / max(rounds, 1)
+        if fused is False:
             stamp_perf(sim)
         return (time.perf_counter() - t0) / rounds * 1e3  # ms/round
 
     plain_ms = run(False)
-    fused_ms = None
+    per_slot_ms = multi_ms = None
     err = None
     if jax.default_backend() != "tpu":
-        err = "fused path skipped off-TPU (pallas interpreter mode is not a meaningful timing)"
+        err = ("fused path skipped off-TPU (pallas interpreter mode is "
+               "not a meaningful timing)")
     else:
         try:
-            fused_ms = run(True)
+            per_slot_ms = run("per_slot")
+            multi_ms = run("multi")
         except Exception as e:  # kernel unavailable on this backend
             err = repr(e)[:200]
-    print(f"[fused-regime] CNN clique-{n}: plain {plain_ms:.1f} ms/round, "
-          f"fused {fused_ms if fused_ms is None else round(fused_ms, 1)} "
-          "ms/round" + (f" (error: {err})" if err else ""), file=sys.stderr)
-    speedup = (plain_ms / fused_ms) if fused_ms else None
+
+    # Deliver-phase attribution runs on EVERY backend: relative per_slot
+    # vs multi is the launch-schedule comparison the mode exists for. On
+    # TPU the CNN legs themselves are traced; off-TPU a small LogReg
+    # config with the IDENTICAL launch schedule stands in (tracing the
+    # CNN through the interpreter costs several full recompiles, and only
+    # the relative schedule is meaningful there anyway).
+    if jax.default_backend() == "tpu":
+        deliver_builder, d_rounds = make_sim, rounds
+        deliver_config = {"model": "CIFAR10Net", "n_nodes": n}
+    else:
+        from gossipy_tpu.models import LogisticRegression
+        d_n, d_dim, d_rounds = 16, 30, 8
+        Xs = rng.normal(size=(d_n * 24, d_dim)).astype(np.float32)
+        ys = (Xs @ rng.normal(size=d_dim) > 0).astype(np.int64)
+        sdisp = DataDispatcher(
+            ClassificationDataHandler(Xs, ys, test_size=0.2),
+            n=d_n, eval_on_user=False)
+        shandler = SGDHandler(
+            model=LogisticRegression(d_dim, 2), loss=losses.cross_entropy,
+            optimizer=optax.sgd(0.1), local_epochs=1, batch_size=8,
+            n_classes=2, input_shape=(d_dim,),
+            create_model_mode=CreateModelMode.MERGE_UPDATE)
+
+        def deliver_builder(fused):
+            return GossipSimulator(shandler, Topology.clique(d_n),
+                                   sdisp.stacked(), delta=ROUND_LEN,
+                                   protocol=AntiEntropyProtocol.PUSH,
+                                   eval_every=d_rounds, fused_merge=fused,
+                                   mailbox_slots=K)
+
+        deliver_config = {"model": "LogisticRegression", "n_nodes": d_n}
+    deliver_ms: dict = {}
+    for fused, leg in legs.items():
+        try:
+            sim = deliver_builder(fused)
+            key = jax.random.PRNGKey(0)
+            state = sim.init_nodes(key, common_init=True)
+            s2, _ = sim.start(state, n_rounds=d_rounds, key=key,
+                              donate_state=False)  # compile outside trace
+            jax.block_until_ready(s2.model.params)
+            ms = _deliver_phase_ms(sim, state, jax.random.PRNGKey(0),
+                                   d_rounds)
+            deliver_ms[leg] = round(ms, 3) if ms is not None else None
+        except Exception as e:
+            deliver_ms[leg] = None
+            print(f"[fused-regime] deliver trace ({leg}) failed: "
+                  f"{repr(e)[:120]}", file=sys.stderr)
+
+    # Bytes-moved model for ONE deliver phase (docs/performance.md "Fused
+    # deliver"): every leg gathers the accepted peer rows off the ring at
+    # wire width; the params matrix is read+written once per PASS — K
+    # passes for plain and per_slot, one for multi — and the plain path
+    # additionally materializes the gathered peer copy at receiver width.
+    sim0 = make_sim(False)
+    wire = sim0.wire_bytes_per_message()
+    p_scalars, _ = sim0._history_param_counts()
+    p_bytes = 4 * p_scalars  # receiver rows are fp32
+    acc = accepted.get("plain", 0.0)
+    gather = acc * wire
+
+    def passes_bytes(passes, materialize=False):
+        moved = passes * 2 * n * p_bytes + gather
+        if materialize:
+            moved += acc * p_bytes
+        return int(round(moved))
+
+    deliver_bytes = {
+        "plain": passes_bytes(K, materialize=True),
+        "per_slot": passes_bytes(K),
+        "multi": passes_bytes(1),
+        "accepted_per_round": round(acc, 2),
+        "wire_bytes_per_message": wire,
+    }
+
+    print(f"[fused-regime] CNN clique-{n} K={K}: plain {plain_ms:.1f} "
+          f"ms/round, per_slot {per_slot_ms and round(per_slot_ms, 1)}, "
+          f"multi {multi_ms and round(multi_ms, 1)}; deliver-phase ms "
+          f"{deliver_ms}" + (f" (error: {err})" if err else ""),
+          file=sys.stderr)
+    speedup = (plain_ms / multi_ms) if multi_ms else None
     emit({
         "metric": "fused_merge_speedup_cnn_clique",
         "value": round(speedup, 3) if speedup else None,
@@ -1331,8 +1461,17 @@ def bench_fused_regime(rounds: int = 40, n: int = 64) -> None:
         "raw": {
             **PERF_INFO,
             "plain_ms_per_round": round(plain_ms, 2),
-            "fused_ms_per_round": (round(fused_ms, 2)
-                                   if fused_ms is not None else None),
+            "fused_ms_per_round": (round(multi_ms, 2)
+                                   if multi_ms is not None else None),
+            "per_slot_ms_per_round": (round(per_slot_ms, 2)
+                                      if per_slot_ms is not None else None),
+            "deliver_ms_per_round": deliver_ms,
+            "deliver_timing_mode": ("tpu" if jax.default_backend() == "tpu"
+                                    else "cpu_interpreter"),
+            "deliver_config": {**deliver_config, "rounds": d_rounds,
+                               "mailbox_slots": K},
+            "deliver_bytes_moved": deliver_bytes,
+            "mailbox_slots": K,
             "n_nodes": n, "topology": "clique", "rounds": rounds,
             "error": err,
         },

@@ -131,6 +131,56 @@ def assert_identical_hlo(sim_a, sim_b, state=None, key=None,
         f"  ({div['sim_a_total']} vs {div['sim_b_total']} instructions)")
 
 
+def _iter_subjaxprs(params: dict):
+    """Yield every sub-jaxpr reachable from an eqn's params (scan/cond/
+    while bodies, pjit calls, custom-vjp closures...). Duck-typed — an
+    object with ``.jaxpr`` is a ClosedJaxpr wrapper, one with ``.eqns`` a
+    Jaxpr — so no version-specific jax.core imports."""
+    for v in params.values():
+        stack = [v]
+        while stack:
+            x = stack.pop()
+            if hasattr(x, "jaxpr"):
+                x = x.jaxpr
+            if hasattr(x, "eqns"):
+                yield x
+            elif isinstance(x, (tuple, list)):
+                stack.extend(x)
+
+
+def _count_eqns(jaxpr, primitive_name: str) -> int:
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == primitive_name:
+            n += 1
+        for sub in _iter_subjaxprs(eqn.params):
+            n += _count_eqns(sub, primitive_name)
+    return n
+
+
+def pallas_launch_count(sim, state=None, key=None, n_rounds: int = 2) -> int:
+    """STATIC pallas-kernel-launch count of the round program.
+
+    Counts ``pallas_call`` eqns in the traced jaxpr of the same
+    ``n_rounds`` scan :func:`lower_text` lowers — the scan body is traced
+    once, so this is launches *per round program* regardless of
+    ``n_rounds``, and both branches of a ``lax.cond`` count (they are both
+    in the program). Works identically in interpret mode (the jaxpr
+    predates lowering), which is what lets CI assert the single-launch
+    fused-deliver property on CPU where the StableHLO carries no
+    custom-call marker.
+    """
+    import jax
+    key = key if key is not None else jax.random.PRNGKey(0)
+    if state is None:
+        state = sim.init_nodes(key)
+    args = (state, key, sim.data)
+    if sim.sentinels is not None:
+        args = args + (sim._health_zero_carry(),)
+    jaxpr = jax.make_jaxpr(sim._make_run(n_rounds, live=False))(*args)
+    return _count_eqns(jaxpr.jaxpr, "pallas_call")
+
+
 # ---------------------------------------------------------------------------
 # The gate matrix (scripts/hlo_gate.py drives this)
 
@@ -232,6 +282,11 @@ def gate_cases() -> dict:
         # HLO-invisible even when ON.
         ("engine/ledger-on",
          lambda: _make_sim(), lambda: _make_sim(ledger=_tmp_ledger())),
+        # Fused-deliver off must be ABSENT: fused_merge=False builds the
+        # byte-identical per-slot deliver loop (fused ON is fingerprinted
+        # and launch-gated below).
+        ("engine/fused-multi-off",
+         lambda: _make_sim(), lambda: _make_sim(fused_merge=False)),
         ("all2all/sentinels-off",
          lambda: _make_sim(all2all=True),
          lambda: _make_sim(all2all=True, sentinels=None)),
@@ -249,5 +304,25 @@ def gate_cases() -> dict:
          lambda: _make_sim(all2all=True, sparse_mix_form="padded")),
         ("all2all/sparse-segment",
          lambda: _make_sim(all2all=True, sparse_mix_form="segment")),
+        ("engine/fused-multi",
+         lambda: _make_sim(fused_merge=True, mailbox_slots=4)),
+        ("engine/fused-compact",
+         lambda: _make_sim(fused_merge=True, compact_deliver=8,
+                           mailbox_slots=4)),
     ]
-    return {"identity": identity, "fingerprint": fingerprint}
+    # Launch-count gate: the one-pass fused deliver drains all K mailbox
+    # slots in EXACTLY one multi-slot kernel launch per deliver program
+    # (two with compact co-enabled: the gathered-batch branch and the wide
+    # fallback branch are both in the lax.cond). Unfused delivers with
+    # gathers only — zero pallas launches. Counted on the traced jaxpr, so
+    # it gates on CPU interpret mode too (see pallas_launch_count).
+    launch = [
+        ("engine/unfused", lambda: _make_sim(mailbox_slots=4), 0),
+        ("engine/fused-multi",
+         lambda: _make_sim(fused_merge=True, mailbox_slots=4), 1),
+        ("engine/fused-compact",
+         lambda: _make_sim(fused_merge=True, compact_deliver=8,
+                           mailbox_slots=4), 2),
+    ]
+    return {"identity": identity, "fingerprint": fingerprint,
+            "launch": launch}

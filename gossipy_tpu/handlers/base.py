@@ -14,7 +14,7 @@ branch.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 
@@ -65,6 +65,12 @@ class BaseHandler:
     # True when ``merge`` is exactly the uniform parameter average with
     # age = max (the engine's pallas fused path may then replace it).
     uniform_avg_merge: bool = False
+    # The peer coefficient of that blend (``out = (1 - w) * own + w * peer``),
+    # declared by handlers whose merge the fused kernel may replace. None
+    # everywhere else, so a future weighted-merge handler that flips
+    # ``uniform_avg_merge`` on without declaring its weight fails loudly at
+    # simulator construction instead of silently averaging at 0.5.
+    merge_peer_weight: Optional[float] = None
 
     # -- abstract ----------------------------------------------------------
     def init(self, key: jax.Array) -> ModelState:

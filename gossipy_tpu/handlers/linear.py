@@ -28,6 +28,7 @@ class AdaLineHandler(BaseHandler):
     """
 
     uniform_avg_merge = True
+    merge_peer_weight = 0.5
 
     def __init__(self, net: AdaLine, learning_rate: float,
                  create_model_mode: CreateModelMode = CreateModelMode.UPDATE):

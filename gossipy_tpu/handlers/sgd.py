@@ -44,6 +44,7 @@ class SGDHandler(BaseHandler):
     """
 
     uniform_avg_merge = True
+    merge_peer_weight = 0.5
 
     def __init__(self,
                  model,

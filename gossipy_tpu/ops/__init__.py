@@ -1,7 +1,9 @@
 """Pallas TPU kernels for the simulation hot paths."""
 
 from .attention import flash_attention, flash_hop_update
-from .merge import gather_merge_flat, gather_merge_pytree
+from .merge import (gather_merge_flat, gather_merge_multi,
+                    gather_merge_multi_pytree, gather_merge_pytree)
 
 __all__ = ["flash_attention", "flash_hop_update", "gather_merge_flat",
+           "gather_merge_multi", "gather_merge_multi_pytree",
            "gather_merge_pytree"]

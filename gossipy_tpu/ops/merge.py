@@ -73,6 +73,53 @@ def _dq_kernel(idx_ref, w_self_ref, w_peer_ref, scale_ref, p_ref, h_ref,
     o_ref[:] = w_self_ref[i] * p_ref[:] + w_peer_ref[i] * peer
 
 
+def _multi_kernel(idx_ref, ws_ref, wp_ref, p_ref, h_ref, o_ref):
+    # Multi-slot variant: grid (rows, feature-blocks, K) with the SLOT axis
+    # minor, so the output block (i, 0, j) is revisited across consecutive k
+    # steps and accumulates in VMEM — one read of p and one write of out per
+    # (row, block) no matter how many mailbox slots drain. Per-slot math is
+    # the same two-way blend as _kernel applied left-to-right, so the result
+    # is bit-identical to K iterated single-slot launches.
+    i = pl.program_id(0)
+    k = pl.program_id(2)
+    w = wp_ref[i, k]
+    # An empty slot carries weight 0 but its (clipped) index may point at an
+    # arbitrary ring row; 0 * row must stay inert even for a non-finite row
+    # (the iterated path discards such products via its per-slot select).
+    contrib = jnp.where(w != 0, w * h_ref[:], 0.0)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[:] = ws_ref[i, 0] * p_ref[:] + contrib
+
+    @pl.when(k > 0)
+    def _accum():
+        o_ref[:] = ws_ref[i, k] * o_ref[:] + contrib
+
+
+def _multi_dq_kernel(lmap_ref, idx_ref, ws_ref, wp_ref, scale_ref, p_ref,
+                     h_ref, o_ref):
+    # Dequantizing multi-slot variant. The concatenated-pytree caller packs
+    # several leaves (each with its OWN per-row int8 scale sidecar) into one
+    # feature axis; ``lmap`` maps each feature block to its leaf so the
+    # [N, K, L] scale table is indexed per (receiver, slot, leaf). The
+    # single-array caller passes L=1 with an all-zero lmap.
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    w = wp_ref[i, k]
+    peer = h_ref[:].astype(o_ref.dtype) * scale_ref[i, k, lmap_ref[j]]
+    contrib = jnp.where(w != 0, w * peer, 0.0)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[:] = ws_ref[i, 0] * p_ref[:] + contrib
+
+    @pl.when(k > 0)
+    def _accum():
+        o_ref[:] = ws_ref[i, k] * o_ref[:] + contrib
+
+
 def gather_merge_reference(p: jax.Array, h: jax.Array, idx: jax.Array,
                            w_self: jax.Array, w_peer: jax.Array,
                            scale: Optional[jax.Array] = None) -> jax.Array:
@@ -189,6 +236,218 @@ def gather_merge_pytree(params, history, flat_idx: jax.Array,
                                 hl.reshape(hl.shape[0] * hl.shape[1], f),
                                 flat_idx, w_self, w_peer, scale=flat_scale,
                                 interpret=interpret)
+        return out.reshape(pl_.shape)
+
+    if scales is None:
+        return jax.tree.map(leaf, params, history)
+    return jax.tree.map(leaf, params, history, scales)
+
+
+# ---------------------------------------------------------------------------
+# Multi-slot form: drain K mailbox slots in ONE kernel launch.
+
+def gather_merge_multi_reference(p: jax.Array, h: jax.Array, idx: jax.Array,
+                                 w_self: jax.Array, w_peer: jax.Array,
+                                 scale: Optional[jax.Array] = None
+                                 ) -> jax.Array:
+    """jnp fallback for the multi-slot kernel: the left-to-right fold of K
+    two-way blends (``idx``/``w_self``/``w_peer`` are [N, K]).
+
+    Zero-weight slots are hard-masked (``where``) rather than multiplied,
+    so a garbage row behind an empty slot's clipped index stays inert even
+    when it is non-finite — matching the kernel, and the per-slot engine
+    path's select-based discard.
+    """
+    out = p
+    for k in range(idx.shape[1]):
+        peer = h[idx[:, k]].astype(p.dtype)
+        if scale is not None:
+            peer = peer * scale[idx[:, k]].astype(p.dtype)[:, None]
+        wp = w_peer[:, k].astype(p.dtype)[:, None]
+        contrib = jnp.where(wp != 0, wp * peer, jnp.zeros_like(peer))
+        out = w_self[:, k].astype(p.dtype)[:, None] * out + contrib
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_f"))
+def _gather_merge_multi_pallas(p, h, idx, w_self, w_peer, scale_g, lmap,
+                               interpret: bool, block_f: int):
+    """One multi-slot launch. ``scale_g`` is ``None`` (no dequant) or the
+    pre-gathered ``[N, K, L]`` per-(receiver, slot, leaf) scale table with
+    ``lmap`` the ``[F/block_f]`` block->leaf map (``None`` = single leaf;
+    requires the feature axis pre-padded to a block multiple when given)."""
+    n, f = p.shape
+    pad = (-f) % block_f
+    assert lmap is None or pad == 0, \
+        "segmented scale tables require block-aligned features"
+    if pad:
+        p = jnp.pad(p, ((0, 0), (0, pad)))
+        h = jnp.pad(h, ((0, 0), (0, pad)))
+    fp = f + pad
+    p3 = p.reshape(n, 1, fp)
+    h3 = h.reshape(h.shape[0], 1, fp)
+
+    if scale_g is not None:
+        if lmap is None:
+            lmap = jnp.zeros((fp // block_f,), jnp.int32)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(n, fp // block_f, idx.shape[1]),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_f),
+                             lambda i, j, k, lm, s, w1, w2, sc: (i, 0, j)),
+                pl.BlockSpec((1, 1, block_f),
+                             lambda i, j, k, lm, s, w1, w2, sc:
+                             (s[i, k], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_f),
+                                   lambda i, j, k, lm, s, w1, w2, sc:
+                                   (i, 0, j)),
+        )
+        out = pl.pallas_call(
+            _multi_dq_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n, 1, fp), p.dtype),
+            interpret=interpret,
+        )(lmap.astype(jnp.int32), idx.astype(jnp.int32),
+          w_self.astype(p.dtype), w_peer.astype(p.dtype),
+          scale_g.astype(p.dtype), p3, h3)
+        return out.reshape(n, fp)[:, :f] if pad else out.reshape(n, fp)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n, fp // block_f, idx.shape[1]),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_f),
+                         lambda i, j, k, s, w1, w2: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_f),
+                         lambda i, j, k, s, w1, w2: (s[i, k], 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_f),
+                               lambda i, j, k, s, w1, w2: (i, 0, j)),
+    )
+    out = pl.pallas_call(
+        _multi_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, 1, fp), p.dtype),
+        interpret=interpret,
+    )(idx.astype(jnp.int32), w_self.astype(p.dtype), w_peer.astype(p.dtype),
+      p3, h3)
+    return out.reshape(n, fp)[:, :f] if pad else out.reshape(n, fp)
+
+
+def gather_merge_multi(p: jax.Array, h: jax.Array, idx: jax.Array,
+                       w_self: jax.Array, w_peer: jax.Array,
+                       scale: Optional[jax.Array] = None,
+                       interpret: Optional[bool] = None,
+                       block_f: int = BLOCK_F) -> jax.Array:
+    """K-slot gather-merge in one launch: the left-to-right fold
+
+        ``out = p``; for each slot ``k``:
+        ``out = w_self[:, k] * out + w_peer[:, k] * dequant(h[idx[:, k]])``
+
+    with ``idx``/``w_self``/``w_peer`` [N, K] tables (one column per
+    mailbox slot; empty slots carry ``(w_self, w_peer) = (1, 0)`` and any
+    in-range index). Where :func:`gather_merge_flat` costs K launches — K
+    full reads of ``p`` and writes of ``out`` — to drain a K-slot mailbox,
+    this reads ``p`` and writes ``out`` exactly once, accumulating the K
+    peer blocks in VMEM. Per-slot math is bit-identical to the iterated
+    single-slot kernel. ``scale``/``interpret`` as in
+    :func:`gather_merge_flat`.
+    """
+    if idx.ndim != 2:
+        raise ValueError(f"idx must be [N, K], got shape {idx.shape}")
+    if not _HAS_PALLAS:
+        return gather_merge_multi_reference(p, h, idx, w_self, w_peer, scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale_g = None
+    if (h.dtype != p.dtype) or (scale is not None):
+        scale_g = (jnp.ones((p.shape[0], idx.shape[1], 1), p.dtype)
+                   if scale is None
+                   else scale[idx].astype(p.dtype)[:, :, None])
+    return _gather_merge_multi_pallas(p, h, idx, w_self, w_peer, scale_g,
+                                      None, interpret, int(block_f))
+
+
+def gather_merge_multi_pytree(params, history, flat_idx: jax.Array,
+                              w_self: jax.Array, w_peer: jax.Array,
+                              scales=None, interpret: Optional[bool] = None,
+                              block_f: int = BLOCK_F):
+    """ONE :func:`gather_merge_multi` launch over a whole stacked params
+    pytree: all leaves flatten-concatenate into a single ``[N, sum(F)]``
+    matrix (and the ring into ``[D*N, sum(F)]``) so a K-slot deliver for
+    the full model is exactly one kernel launch — per-leaf launches would
+    re-pay the launch and the scalar-prefetch table per leaf.
+
+    Same layout contract as :func:`gather_merge_pytree`, with ``flat_idx``
+    and the weights widened to [N, K] slot tables: ``flat_idx[i, k] =
+    (send_round_ik % D) * N + sender_ik``. With int8 ``scales`` each leaf
+    keeps its own per-row sidecar: leaves are padded to feature-block
+    multiples so every block belongs to one leaf, and the kernel picks the
+    leaf's scale through a block->leaf map (see ``_multi_dq_kernel``).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    hleaves = jax.tree_util.tree_leaves(history)
+    n = leaves[0].shape[0]
+    # Ring rows come from the HISTORY shape: under compact deliver the
+    # params rows are a gathered [cap] subset while the ring stays [D, N].
+    m = hleaves[0].shape[0] * hleaves[0].shape[1]
+    if not _HAS_PALLAS:
+        return gather_merge_multi_reference_pytree(
+            params, history, flat_idx, w_self, w_peer, scales)
+    widths = [int(np.prod(l.shape[1:])) if l.ndim > 1 else 1 for l in leaves]
+
+    if scales is None:
+        # Shared (or absent) wire transform across the whole row: plain
+        # concat, the kernel's fp pad covers block alignment.
+        p_cat = jnp.concatenate(
+            [l.reshape(n, f) for l, f in zip(leaves, widths)], axis=1)
+        h_cat = jnp.concatenate(
+            [hl.reshape(m, f) for hl, f in zip(hleaves, widths)], axis=1)
+        out = gather_merge_multi(p_cat, h_cat, flat_idx, w_self, w_peer,
+                                 interpret=interpret, block_f=block_f)
+        splits = jnp.split(out, np.cumsum(widths)[:-1], axis=1)
+        return jax.tree_util.tree_unflatten(
+            treedef, [s.reshape(l.shape) for s, l in zip(splits, leaves)])
+
+    sleaves = jax.tree_util.tree_leaves(scales)
+    padded = [_cdiv(f, block_f) * block_f for f in widths]
+    p_cat = jnp.concatenate(
+        [jnp.pad(l.reshape(n, f), ((0, 0), (0, w - f)))
+         for l, f, w in zip(leaves, widths, padded)], axis=1)
+    h_cat = jnp.concatenate(
+        [jnp.pad(hl.reshape(m, f), ((0, 0), (0, w - f)))
+         for hl, f, w in zip(hleaves, widths, padded)], axis=1)
+    scale_g = jnp.stack([sl.reshape(m)[flat_idx] for sl in sleaves],
+                        axis=-1).astype(p_cat.dtype)  # [N, K, L]
+    lmap = jnp.asarray(
+        np.repeat(np.arange(len(leaves)), [w // block_f for w in padded]),
+        jnp.int32)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = _gather_merge_multi_pallas(p_cat, h_cat, flat_idx, w_self, w_peer,
+                                     scale_g, lmap, interpret, int(block_f))
+    splits = jnp.split(out, np.cumsum(padded)[:-1], axis=1)
+    return jax.tree_util.tree_unflatten(
+        treedef, [s[:, :f].reshape(l.shape)
+                  for s, f, l in zip(splits, widths, leaves)])
+
+
+def gather_merge_multi_reference_pytree(params, history, flat_idx: jax.Array,
+                                        w_self: jax.Array, w_peer: jax.Array,
+                                        scales=None):
+    """:func:`gather_merge_multi_reference` over a stacked params pytree —
+    the pure-jnp twin of :func:`gather_merge_multi_pytree` (probe-side
+    recomputation must not add kernel launches to the round program)."""
+    def leaf(pl_, hl, sl=None):
+        n = pl_.shape[0]
+        f = int(np.prod(pl_.shape[1:])) if pl_.ndim > 1 else 1
+        flat_scale = (None if sl is None
+                      else sl.reshape(sl.shape[0] * sl.shape[1]))
+        out = gather_merge_multi_reference(
+            pl_.reshape(n, f), hl.reshape(hl.shape[0] * hl.shape[1], f),
+            flat_idx, w_self, w_peer, scale=flat_scale)
         return out.reshape(pl_.shape)
 
     if scales is None:

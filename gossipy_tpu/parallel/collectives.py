@@ -38,6 +38,29 @@ from jax.sharding import Mesh
 from . import _node_axis_entry
 from .rules import node_leading_spec, replicated_spec
 
+# ``shard_map`` became a top-level jax API (varying-axes switch named
+# ``check_vma``) after living in ``jax.experimental.shard_map`` (same switch
+# named ``check_rep``). Resolve once at import so every collective runs on
+# either vintage; callers below always use the ``check_vma`` spelling.
+try:
+    _shard_map_impl = jax.shard_map
+    _SM_CHECK_KW = "check_vma"
+except AttributeError:  # pre-public-API jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SM_CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **{_SM_CHECK_KW: check_vma})
+
+
+def _pcast_varying(x, axis_name):
+    """Mark ``x`` device-varying where the jax build has varying-axes types;
+    a no-op on builds that predate them (nothing to mark there)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    return x if pcast is None else pcast(x, axis_name, to="varying")
+
 
 def _ring_perm(d: int):
     """Send each shard to the previous ring position (i -> i-1 mod d), so
@@ -72,7 +95,7 @@ def _ring_hops(d: int, axis_name, hop, init):
     # The loop carry must have a stable varying-axes type: the initial
     # accumulator (a plain zeros, device-invariant) becomes device-varying
     # after one hop, so mark it varying up front.
-    carry = jax.lax.pcast(carry, axis_name, to="varying")
+    carry = _pcast_varying(carry, axis_name)
     carry, chunk = jax.lax.fori_loop(0, d - 1, body, (carry, chunk))
     return hop(d - 1, carry, chunk)
 
@@ -109,7 +132,7 @@ def ring_all_gather(x: jax.Array, mesh: Mesh,
     # ppermute ring is not statically inferable — skip the varying-axes check.
     # I/O specs derive from the rule registry's primitives: the input is
     # node-leading, the gathered output replicated (parallel/rules.py).
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=node_leading_spec(x.ndim, axis_name),
              out_specs=replicated_spec(x.ndim), check_vma=False)
     def body(chunk):
@@ -145,7 +168,7 @@ def ring_mixed_matmul(w: jax.Array, x: jax.Array, mesh: Mesh,
     assert n % d == 0, f"node axis {n} not divisible by mesh axis {d}"
     nl = n // d
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(node_leading_spec(2, axis_name),
                        node_leading_spec(2, axis_name)),
              out_specs=node_leading_spec(2, axis_name))
@@ -206,7 +229,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     scale = 1.0 / np.sqrt(dim)
     NEG = jnp.asarray(-1e30, jnp.float32)  # finite: exp() stays nan-free
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(node_leading_spec(2, axis_name),) * 3,
              # The pallas hop kernel's interpreter mode does not thread
              # varying-axes types onto in-kernel constants, so the vma
@@ -237,6 +260,104 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q.dtype)
 
     return body(q, k, v)
+
+
+def sharded_gather_merge_multi(params, history, flat_idx: jax.Array,
+                               w_self: jax.Array, w_peer: jax.Array,
+                               mesh: Mesh, scales=None, axis_name=None):
+    """The engine's multi-slot fused merge, sharded over the mesh's node
+    axis: each device merges its OWN receiver rows while the history-ring
+    chunks rotate around a ppermute ring — the merge math runs on each
+    replica's shard instead of replicated (the cross-replica sharded
+    weight-update pattern, PAPERS.md).
+
+    ``params`` leaves are ``[N, ...]`` and ``history`` leaves ``[D, N,
+    ...]`` (the engine's ring, fp32 or a wire format with optional
+    ``scales``); ``flat_idx``/``w_self``/``w_peer`` are the ``[N, K]``
+    slot tables of :func:`~gossipy_tpu.ops.merge.gather_merge_multi`.
+    Per hop, ONE multi-slot kernel launch folds in every slot whose
+    sender is resident in the rotating chunk.
+
+    A rotating accumulation cannot honor slot order, so the left-to-right
+    fold is first rewritten in its composed linear form::
+
+        out = (prod_k ws_k) * p + sum_k [wp_k * prod_{j>k} ws_j] * peer_k
+
+    which is hop-order independent — equal to the unsharded fold up to fp
+    reassociation (the unsharded kernel stays the bit-compatibility
+    reference). Leaves ride one ring concatenated, like
+    :func:`ring_mix_pytree`. I/O specs derive from the rule registry's
+    primitives (parallel/rules.py).
+    """
+    from ..ops.merge import gather_merge_multi
+
+    axis_name = _node_axis_entry(mesh, axis_name)
+    d = _axis_size(mesh, axis_name)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    hleaves = jax.tree_util.tree_leaves(history)
+    sleaves = (jax.tree_util.tree_leaves(scales) if scales is not None
+               else [None] * len(leaves))
+    n = leaves[0].shape[0]
+    assert n % d == 0, f"node axis {n} not divisible by mesh axis {d}"
+    nl = n // d
+    D = hleaves[0].shape[0]
+
+    cat_dtype = jnp.result_type(*leaves)
+    flats, hflats, widths = [], [], []
+    for pl_, hl, sl in zip(leaves, hleaves, sleaves):
+        f = int(np.prod(pl_.shape[1:])) if pl_.ndim > 1 else 1
+        flats.append(pl_.reshape(n, f).astype(cat_dtype))
+        h = hl.reshape(D, n, f).astype(cat_dtype)
+        if sl is not None:
+            # int8 wire rows dequantize where they LIVE (each device's own
+            # ring shard), before the fp chunk enters the ring.
+            h = h * sl.reshape(D, n, 1).astype(cat_dtype)
+        hflats.append(h)
+        widths.append(f)
+    p_cat = jnp.concatenate(flats, axis=1)
+    h_cat = jnp.concatenate(hflats, axis=2)
+    fsum = p_cat.shape[1]
+
+    # Composed linear weights (hop-order independent): W0 = prod_k ws_k,
+    # Wk = wp_k * prod_{j>k} ws_j.
+    ws = w_self.astype(cat_dtype)
+    wp = w_peer.astype(cat_dtype)
+    rev = jnp.cumprod(ws[:, ::-1], axis=1)[:, ::-1]  # prod_{j>=k} ws_j
+    w0 = rev[:, 0]
+    suffix = jnp.concatenate(
+        [rev[:, 1:], jnp.ones((n, 1), cat_dtype)], axis=1)
+    wk = wp * suffix
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(node_leading_spec(2, axis_name),
+                       node_leading_spec(3, axis_name, 1),
+                       node_leading_spec(2, axis_name),
+                       node_leading_spec(1, axis_name),
+                       node_leading_spec(2, axis_name)),
+             out_specs=node_leading_spec(2, axis_name), check_vma=False)
+    def body(p_l, h_l, idx_l, w0_l, wk_l):
+        me = jax.lax.axis_index(axis_name)
+        h_flat = h_l.reshape(D * nl, fsum)
+        bb = idx_l // n  # ring cell of each (receiver, slot)
+        ss = idx_l % n   # global sender of each (receiver, slot)
+
+        def hop(s, acc, ch):
+            src = (me + s) % d
+            lo = src * nl
+            res = (ss >= lo) & (ss < lo + nl)
+            lidx = jnp.clip(bb * nl + (ss - lo), 0, D * nl - 1)
+            wp_hop = jnp.where(res, wk_l, 0)
+            return gather_merge_multi(acc, ch, lidx.astype(jnp.int32),
+                                      jnp.ones_like(wp_hop), wp_hop)
+
+        acc0 = w0_l[:, None] * p_l
+        return _ring_hops(d, axis_name, hop, (acc0, h_flat))
+
+    mixed = body(p_cat, h_cat, flat_idx, w0, wk)
+    splits = jnp.split(mixed, np.cumsum(widths)[:-1], axis=1)
+    out = [s.reshape(l.shape).astype(l.dtype)
+           for s, l in zip(splits, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def ring_mix_pytree(w: jax.Array, params, mesh: Mesh,

@@ -510,8 +510,9 @@ class GossipSimulator(SimulationEventSender):
                  mailbox_slots: Optional[int] = None,
                  reply_slots: int = 2,
                  message_size: Optional[int] = None,
-                 fused_merge: bool = False,
+                 fused_merge: Union[bool, str] = False,
                  compact_deliver: Optional[bool] = None,
+                 mesh=None,
                  max_fires_per_round: Optional[int] = None,
                  history_dtype: str = "float32",
                  probes: Union[None, bool, ProbeConfig] = None,
@@ -585,20 +586,57 @@ class GossipSimulator(SimulationEventSender):
         self._metric_names: Optional[list[str]] = None
         self._jit_cache: dict = {}
 
-        self.fused_merge = bool(fused_merge)
+        # fused_merge: False | "multi" (the default spelling of True: ONE
+        # multi-slot kernel launch + ONE vmapped update drains the whole
+        # mailbox cell) | "per_slot" (legacy: one launch + one update per
+        # occupied slot — interleaved per-slot semantics, kept for A/B
+        # measurement and strict multi-arrival parity with the unfused
+        # path).
+        if fused_merge is True:
+            fused_merge = "multi"
+        elif fused_merge and fused_merge not in ("multi", "per_slot"):
+            raise ValueError(
+                f"unknown fused_merge mode {fused_merge!r}; options: "
+                "False, True/'multi', 'per_slot'")
+        self.fused_merge = fused_merge
         if self.fused_merge:
             # The fused kernel replaces the whole gather->decode->apply slot
             # pipeline; any variant customizing one of those hooks would be
             # silently bypassed.
-            for hook in ("_apply_receive", "_receive_rows", "_gather_peer",
-                         "_decode_extra"):
+            hooks = ["_apply_receive", "_receive_rows", "_gather_peer",
+                     "_decode_extra"]
+            if self.fused_merge == "multi":
+                # The single-pass form additionally collapses the slot loop:
+                # per-slot hooks and per-slot reply payloads would observe a
+                # state ordering that no longer exists.
+                hooks += ["_post_receive_slot", "_reply_extra"]
+            for hook in hooks:
                 assert getattr(type(self), hook) is getattr(GossipSimulator, hook), \
                     f"fused_merge requires the base receive path ({hook} is " \
                     f"overridden by {type(self).__name__})"
             assert getattr(handler, "uniform_avg_merge", False), \
                 "fused_merge requires a uniform-average merge handler"
+            assert getattr(handler, "merge_peer_weight", None) is not None, \
+                "fused_merge requires the handler to declare its blend " \
+                "coefficient (merge_peer_weight)"
             assert handler.mode == CreateModelMode.MERGE_UPDATE, \
                 "fused_merge only fuses the MERGE_UPDATE path"
+        # Mesh-sharded fused deliver: the multi-slot kernel runs inside a
+        # shard_map over the mesh's node axis (parallel.collectives ring),
+        # so the merge+update math executes on each replica's shard instead
+        # of replicated. Placement derives from the rule registry's
+        # primitives — no hand-placed specs (tests/test_rules.py AST test).
+        self.mesh = mesh
+        if mesh is not None:
+            assert self.fused_merge == "multi", \
+                "GossipSimulator(mesh=) shards the single-pass fused " \
+                "deliver; pass fused_merge=True/'multi'"
+            from ..parallel import _node_axis_entry
+            from ..parallel.collectives import _axis_size
+            self._fused_ring_axis = _node_axis_entry(mesh, None)
+            assert self.n_nodes % _axis_size(mesh, self._fused_ring_axis) == 0, \
+                "node count must divide the mesh's node axes for the " \
+                "sharded fused deliver"
 
         # Compaction re-routes the gather->decode->apply slot pipeline
         # through [cap]-shaped sub-batches; like fused_merge it is only
@@ -636,9 +674,14 @@ class GossipSimulator(SimulationEventSender):
                 "only correct for row-aligned/elementwise overrides — set " \
                 "the attribute after checking the contract, or pass " \
                 "compact_deliver=False"
-            assert not self.fused_merge, \
-                "compact_deliver and fused_merge are mutually exclusive " \
-                "deliver paths"
+            assert self.fused_merge != "per_slot", \
+                "compact_deliver composes with the single-pass fused " \
+                "deliver (fused_merge=True/'multi') but not the legacy " \
+                "per-slot fused path"
+            assert self.mesh is None, \
+                "compact_deliver gathers a [cap] row subset, which the " \
+                "mesh-sharded fused deliver cannot re-shard; use one or " \
+                "the other"
         if compact_deliver and not isinstance(compact_deliver, bool):
             # Explicit integer capacity (tests / tuning); overflow still
             # falls back to the full-width pass, so ANY positive value is
@@ -1553,7 +1596,11 @@ class GossipSimulator(SimulationEventSender):
         D = state.history_ages.shape[0]
         s = jnp.clip(sender, 0, n - 1)
         flat_idx = ((send_round % D) * n + s).astype(jnp.int32)
-        w_peer = jnp.where(valid, 0.5, 0.0).astype(jnp.float32)
+        # The handler DECLARED this blend coefficient (construction
+        # asserts merge_peer_weight alongside uniform_avg_merge) — 0.5 for
+        # the uniform average, never a silent kernel-side default.
+        wp = float(self.handler.merge_peer_weight)
+        w_peer = jnp.where(valid, wp, 0.0).astype(jnp.float32)
         w_self = 1.0 - w_peer
         # Quantized rings dequantize INSIDE the kernel (bf16: widen the DMA'd
         # block; int8: scalar-prefetched per-row scales) — the fp32 peer copy
@@ -1571,6 +1618,279 @@ class GossipSimulator(SimulationEventSender):
             updated = jax.vmap(self.handler.update)(merged, self._local_data(),
                                                     keys)
         return state._replace(model=select_nodes(valid, updated, state.model))
+
+    # -- single-pass fused deliver (fused_merge="multi") --------------------
+
+    def _fused_multi_tables(self, state: SimState, sr_t, sender_t, apply_t):
+        """The [rows, K] kernel tables for one mailbox cell: flat ring
+        indices, per-slot blend weights (``(1, 0)`` for empty slots — the
+        kernel hard-masks zero-weight slots), and the peer ages. ``rows``
+        is N for the wide pass, the gathered [cap] subset under
+        compaction (the ring index space stays the full [D*N])."""
+        n = self.n_nodes
+        D = state.history_ages.shape[0]
+        s = jnp.clip(sender_t, 0, n - 1)
+        flat_idx = ((sr_t % D) * n + s).astype(jnp.int32)
+        wp = float(self.handler.merge_peer_weight)
+        w_peer = jnp.where(apply_t, wp, 0.0).astype(jnp.float32)
+        w_self = 1.0 - w_peer
+        peer_ages = state.history_ages[sr_t % D, s]
+        return flat_idx, w_self, w_peer, peer_ages
+
+    def _fused_slot_keys(self, base_key, r, purposes, apply_t):
+        """Per-node key for the ONE fused update: build the same per-slot
+        ``split(key, N)`` tables the per-slot path draws from, then select
+        each node's FIRST live slot's key — so wherever fan-in <= 1 the
+        update consumes bit-identical PRNG streams to the per-slot path."""
+        n = self.n_nodes
+        tabs = jnp.stack([
+            jax.random.split(self._round_key(base_key, r, p), n)
+            for p in purposes])
+        first_k = jnp.argmax(apply_t, axis=1)
+        return tabs[first_k, jnp.arange(n)]
+
+    def _fused_multi_merge_update(self, model: ModelState, history_params,
+                                  history_scale, flat_idx, w_self, w_peer,
+                                  peer_ages, apply_t, keys, row_valid,
+                                  data) -> ModelState:
+        """One kernel launch + one vmapped update over ``rows`` receivers:
+        the compound left-to-right K-slot blend, age = max over the live
+        peers, then ``handler.update`` once per receiver with >= 1 live
+        message."""
+        scales = history_scale if self.history_dtype == "int8" else None
+        if self.mesh is not None:
+            from ..parallel.collectives import sharded_gather_merge_multi
+            merged_params = sharded_gather_merge_multi(
+                model.params, history_params, flat_idx, w_self, w_peer,
+                self.mesh, scales=scales, axis_name=self._fused_ring_axis)
+        else:
+            from ..ops import gather_merge_multi_pytree
+            merged_params = gather_merge_multi_pytree(
+                model.params, history_params, flat_idx, w_self, w_peer,
+                scales=scales)
+        ages = jnp.maximum(model.n_updates,
+                           jnp.where(apply_t, peer_ages, 0).max(axis=1))
+        merged = ModelState(merged_params, model.opt_state, ages)
+        with jax.named_scope(PHASE_TRAIN):
+            updated = jax.vmap(self.handler.update)(merged, data, keys)
+        return select_nodes(row_valid, updated, model)
+
+    def _fused_multi_apply(self, state: SimState, sr_t, sender_t, apply_t,
+                           keys, any_msg) -> SimState:
+        flat_idx, w_self, w_peer, peer_ages = self._fused_multi_tables(
+            state, sr_t, sender_t, apply_t)
+        model = self._fused_multi_merge_update(
+            state.model, state.history_params, state.history_scale,
+            flat_idx, w_self, w_peer, peer_ages, apply_t, keys, any_msg,
+            self._local_data())
+        return state._replace(model=model)
+
+    def _fused_multi_apply_compact(self, state: SimState, sr_t, sender_t,
+                                   apply_t, keys, any_msg) -> SimState:
+        """The fused single pass over the [cap] gathered live receivers
+        (same stable valid-first argsort + scatter-back contract as
+        :meth:`_apply_receive_compact`; only reachable behind the
+        ``live <= cap`` cond)."""
+        cap = self._compact_cap
+        order = jnp.argsort(jnp.where(any_msg, 0, 1), stable=True)
+        idx = jax.lax.slice_in_dim(order, 0, cap)
+        take = lambda l: l[idx] if getattr(l, "ndim", 0) else l
+        flat_idx, w_self, w_peer, peer_ages = self._fused_multi_tables(
+            state, sr_t[idx], sender_t[idx], apply_t[idx])
+        sub_model = jax.tree.map(take, state.model)
+        new_sub = self._fused_multi_merge_update(
+            sub_model, state.history_params, state.history_scale, flat_idx,
+            w_self, w_peer, peer_ages, apply_t[idx], keys[idx],
+            any_msg[idx], jax.tree.map(take, self._local_data()))
+        model = jax.tree.map(
+            lambda full, part: (full.at[idx].set(part)
+                                if getattr(full, "ndim", 0) else full),
+            state.model, new_sub)
+        return state._replace(model=model)
+
+    def _fused_multi_dispatch(self, state: SimState, sr_t, sender_t,
+                              apply_t, keys):
+        """Runtime wide/compact dispatch around the single fused pass.
+        Returns ``(state, n_compact, n_wide)`` where the path counters
+        attribute the cell's occupied-slot count to whichever branch ran
+        (the per-slot loop's per-slot tallies, summed)."""
+        any_msg = apply_t.any(axis=1)
+        has_any = any_msg.any()
+        occ_slots = apply_t.any(axis=0).sum().astype(jnp.int32)
+
+        def deliver(st):
+            if self._compact_cap is None:
+                return self._fused_multi_apply(st, sr_t, sender_t, apply_t,
+                                               keys, any_msg)
+            return jax.lax.cond(
+                self._slot_live_count(any_msg) <= self._compact_cap,
+                lambda s2: self._fused_multi_apply_compact(
+                    s2, sr_t, sender_t, apply_t, keys, any_msg),
+                lambda s2: self._fused_multi_apply(
+                    s2, sr_t, sender_t, apply_t, keys, any_msg),
+                st)
+
+        state = jax.lax.cond(has_any, deliver, lambda st: st, state)
+        if self._compact_cap is None:
+            return state, jnp.int32(0), \
+                jnp.where(has_any, occ_slots, jnp.int32(0))
+        took_compact = has_any & (
+            self._slot_live_count(any_msg) <= self._compact_cap)
+        return (state,
+                jnp.where(took_compact, occ_slots, jnp.int32(0)),
+                jnp.where(has_any & ~took_compact, occ_slots, jnp.int32(0)))
+
+    def _fused_multi_probe(self, pa: "ProbeAccum", pre_state: SimState,
+                           post_state: SimState, sr_t, sender_t, apply_t,
+                           r) -> "ProbeAccum":
+        """Per-slot probe accounting recomputed from the [N, K] tables:
+        accepted counts and staleness fold slot-by-slot (bit-equal to the
+        per-slot loop); the merge/train delta decomposition measures the
+        COMPOUND merge (what this path actually applied), recomputed as a
+        pure jnp probe so it adds no kernel launch."""
+        def pbody(k, pa):
+            return pa.record_slot(apply_t[:, k], r - sr_t[:, k])
+
+        pa = jax.lax.fori_loop(0, sr_t.shape[1], pbody, pa)
+        if not self._probe_delta_ok:
+            return pa
+        any_msg = apply_t.any(axis=1)
+
+        def deltas():
+            from ..ops.merge import gather_merge_multi_reference_pytree
+            flat_idx, w_self, w_peer, _ = self._fused_multi_tables(
+                pre_state, sr_t, sender_t, apply_t)
+            scales = (pre_state.history_scale
+                      if self.history_dtype == "int8" else None)
+            merged = gather_merge_multi_reference_pytree(
+                pre_state.model.params, pre_state.history_params, flat_idx,
+                w_self, w_peer, scales=scales)
+            merged_p = select_nodes(any_msg, merged, pre_state.model.params)
+            return (sq_param_distance(merged_p, pre_state.model.params),
+                    sq_param_distance(post_state.model.params, merged_p))
+
+        m_sq, t_sq = jax.lax.cond(
+            any_msg.any(), deltas,
+            lambda: (jnp.float32(0), jnp.float32(0)))
+        return pa._replace(merge_sq=pa.merge_sq + m_sq,
+                           train_sq=pa.train_sq + t_sq)
+
+    def _fused_deliver_all(self, state: SimState, base_key, r, online,
+                           forced, b, size):
+        """Single-pass fused deliver: hoist the cell's K-slot mailbox
+        metadata into [N, K] tables, drain every slot with ONE multi-slot
+        kernel launch + ONE vmapped ``handler.update``, and recompute the
+        per-slot accounting (failure causes, accepted counts, staleness
+        histogram, sentinel first-bad-slot, reply traffic) from the same
+        tables.
+
+        Semantics vs the per-slot paths: a receiver with m > 1 live
+        messages applies the compound left-to-right blend of all m
+        snapshots and trains ONCE (the per-slot paths interleave m
+        merge+train passes). Rounds with fan-in <= 1 everywhere match the
+        unfused path up to fp reassociation; the integer accounting is
+        bit-equal regardless of fan-in (it depends only on the mailbox
+        tables). Returns ``(state, fails, n_sent_replies,
+        reply_size_total, n_compact, n_wide, pa, first_bad)``.
+        """
+        n = self.n_nodes
+        box = state.mailbox
+        sender_t = box.sender[b]
+        sr_t = box.send_round[b]
+        ty_t = box.msg_type[b]
+        occupied_t = sender_t >= 0
+        valid_t = occupied_t & online[:, None]
+        carries_t = ((ty_t == MessageType.PUSH)
+                     | (ty_t == MessageType.PUSH_PULL)
+                     | (ty_t == MessageType.REPLY))
+        apply_t = valid_t & carries_t
+
+        fails = self._fc_zeros()
+        if self.chaos is not None:
+            fails = fails.add_chaos((occupied_t & forced[:, None]).sum())
+            fails = fails._replace(
+                offline=fails.offline
+                + (occupied_t & ~forced[:, None] & ~online[:, None]).sum())
+        else:
+            fails = fails._replace(
+                offline=fails.offline + (occupied_t & ~online[:, None]).sum())
+
+        keys = self._fused_slot_keys(
+            base_key, r, [_K_CALL * 101 + k for k in range(self.K)],
+            apply_t)
+        probes_on = self._probe_slots_on()
+        pre_state = state if probes_on else None
+        state, n_compact, n_wide = self._fused_multi_dispatch(
+            state, sr_t, sender_t, apply_t, keys)
+
+        pa = None
+        if probes_on:
+            pa = self._fused_multi_probe(self._probe_zero_accum(), pre_state,
+                                         state, sr_t, sender_t, apply_t, r)
+        first_bad = None
+        if self._health_slots_on():
+            # Blame resolution is phase-level here: a non-finite outcome
+            # names the FIRST occupied slot (the compound pass has no
+            # per-slot intermediate states to bisect). Clean rounds are
+            # bit-equal to the per-slot accumulator (-1).
+            occ_k = apply_t.any(axis=0)
+
+            def _scan_bad():
+                bad = nonfinite_total(state.model.params) > 0
+                return jnp.where(bad, jnp.argmax(occ_k).astype(jnp.int32),
+                                 jnp.int32(-1))
+
+            first_bad = jax.lax.cond(occ_k.any(), _scan_bad,
+                                     lambda: jnp.int32(-1))
+
+        n_sent_replies = jnp.int32(0)
+        reply_size_total = jnp.int32(0)
+        if self._replies_possible():
+            # Reply traffic is metadata-only (no model math), so the slot
+            # loop survives as a pure scatter loop with the SAME key
+            # purposes — the reply box contents stay bit-identical to the
+            # per-slot path's.
+            def rbody(k, carry):
+                rbox, fails, nsr, rst = carry
+                sender = jnp.take(sender_t, k, axis=1)
+                ty = jnp.take(ty_t, k, axis=1)
+                valid = jnp.take(valid_t, k, axis=1)
+                wants_reply = (ty == MessageType.PULL) | \
+                              (ty == MessageType.PUSH_PULL)
+                reply_needed = valid & wants_reply
+                rkey = self._round_key(base_key, r, _K_REPLY_DELAY * 101 + k)
+                rdrop = jax.random.bernoulli(
+                    self._round_key(base_key, r, _K_REPLY_DROP * 101 + k),
+                    self._chaos_drop_prob(r), (n,))
+                rdelay = self._chaos_scale_delays(
+                    self.delay.sample(rkey, (n,), size), r)
+                rdr = rdelay // self.delta
+                nsr += reply_needed.sum()
+                rst += reply_needed.sum() * size
+                fails = fails._replace(
+                    drop=fails.drop + (reply_needed & rdrop).sum())
+                live = reply_needed & ~rdrop
+                rbox, n_overflow = self._scatter_messages(
+                    rbox, live, rdr, sender, jnp.arange(n, dtype=jnp.int32),
+                    jnp.broadcast_to(r.astype(jnp.int32), (n,)),
+                    jnp.full((n,), int(MessageType.REPLY), dtype=jnp.int32),
+                    self._reply_extra(
+                        self._round_key(base_key, r,
+                                        (_K_EXTRA + 31) * 101 + k),
+                        state), r, self.Kr)
+                fails = fails._replace(
+                    overflow=fails.overflow + n_overflow)
+                return rbox, fails, nsr, rst
+
+            rbox, fails, n_sent_replies, reply_size_total = \
+                jax.lax.fori_loop(
+                    0, self.K, rbody,
+                    (state.reply_box, fails, n_sent_replies,
+                     reply_size_total))
+            state = state._replace(reply_box=rbox)
+
+        return (state, fails, n_sent_replies, reply_size_total, n_compact,
+                n_wide, pa, first_bad)
 
     def _decode_extra(self, extra: jax.Array):
         """Map the int32 wire field to the handler's ``extra`` argument.
@@ -1795,14 +2115,36 @@ class GossipSimulator(SimulationEventSender):
         hwm = (state.mailbox.sender[b] >= 0).sum(axis=1).max() \
             .astype(jnp.int32)
 
+        probes_on = self._probe_slots_on()
+        health_on = self._health_slots_on()
+
+        if self.fused_merge == "multi":
+            # Single-pass fused deliver: no slot loop at all — one kernel
+            # launch + one vmapped update drains every slot (the K full
+            # [N, F] params read+write round-trips of the per-slot paths
+            # collapse to one).
+            state, fails, n_sent_replies, reply_size_total, n_compact, \
+                n_wide, pa, first_bad = self._fused_deliver_all(
+                    state, base_key, r, online,
+                    forced if self.chaos is not None else None, b, size)
+            state = state._replace(mailbox=state.mailbox.clear_cell(b))
+            state, ex_sent, ex_fails, ex_size = \
+                self._post_deliver(state, base_key, r)
+            diag = {"mailbox_hwm": hwm, "compact_slots": n_compact,
+                    "wide_slots": n_wide}
+            if probes_on:
+                diag["probe_accum"] = pa
+            if health_on:
+                diag["first_bad_slot"] = first_bad
+            return state, n_sent_replies + ex_sent, fails + ex_fails, \
+                reply_size_total + ex_size, diag
+
         # One fori_loop iteration per mailbox slot: the compiled program
         # contains ONE copy of the merge+train graph regardless of K (an
         # unrolled loop multiplies HLO size and compile time by K — minutes
         # for CNN configs). Slot index k is TRACED: it feeds fold_in key
         # derivation, dynamic slot reads, and the _post_receive_slot hook —
         # subclass hooks must treat k as an array, not a Python int.
-        probes_on = self._probe_slots_on()
-        health_on = self._health_slots_on()
 
         def slot_body(k, carry):
             state, fails, n_sent_replies, reply_size_total, \
@@ -1968,6 +2310,40 @@ class GossipSimulator(SimulationEventSender):
         if self.chaos is not None:
             forced = self._chaos_forced_offline(r)
             online = online & ~forced
+
+        if self.fused_merge == "multi":
+            # Same single-pass hoist as the deliver phase, over the reply
+            # box's Kr slots (REPLY messages always carry models, so the
+            # apply mask is just occupied & online).
+            sender_t = state.reply_box.sender[b]
+            sr_t = state.reply_box.send_round[b]
+            occupied_t = sender_t >= 0
+            apply_t = occupied_t & online[:, None]
+            fails = self._fc_zeros()
+            if self.chaos is not None:
+                fails = fails.add_chaos((occupied_t & forced[:, None]).sum())
+                fails = fails._replace(
+                    offline=fails.offline
+                    + (occupied_t & ~forced[:, None]
+                       & ~online[:, None]).sum())
+            else:
+                fails = fails._replace(
+                    offline=fails.offline
+                    + (occupied_t & ~online[:, None]).sum())
+            keys = self._fused_slot_keys(
+                base_key, r,
+                [(_K_CALL + 53) * 101 + k for k in range(self.Kr)], apply_t)
+            pre_state = state if probes_on else None
+            state, n_compact, n_wide = self._fused_multi_dispatch(
+                state, sr_t, sender_t, apply_t, keys)
+            diag = {"compact_slots": n_compact, "wide_slots": n_wide}
+            if probes_on:
+                diag["probe_accum"] = self._fused_multi_probe(
+                    self._probe_zero_accum(), pre_state, state, sr_t,
+                    sender_t, apply_t, r)
+            state = state._replace(reply_box=state.reply_box.clear_cell(b))
+            return state, fails, diag
+
         def slot_body(k, carry):
             if probes_on:
                 state, fails, n_compact, n_wide, pa = carry

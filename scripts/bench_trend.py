@@ -141,8 +141,8 @@ def analyze(entries: list, max_regress: float) -> tuple[str, list]:
         es = sorted(groups[(metric, backend)], key=lambda e: e["order"])
         lines += [f"## {metric} ({backend})", "",
                   "| source | value | unit | host blk% | stream× "
-                  "| degraded | note |",
-                  "|---|---:|---|---:|---:|---|---|"]
+                  "| deliver× | deliver MB | degraded | note |",
+                  "|---|---:|---|---:|---:|---:|---:|---|---|"]
         clean = [e for e in es if not _degraded(e["row"])]
         best_prior = None
         if len(clean) >= 2:
@@ -177,11 +177,26 @@ def analyze(entries: list, max_regress: float) -> tuple[str, list]:
             # rows without a streaming variant.
             spd = (row.get("raw") or {}).get("stream_speedup")
             spd_cell = f"{float(spd):.2f}" if spd is not None else ""
+            # deliver_ms_per_round / deliver_bytes_moved: bench.py
+            # --fused-regime's per-leg deliver-phase A/B. deliver× is
+            # the multi-slot kernel's gain over the per-slot fused leg
+            # (same config, same trace harness); deliver MB is the
+            # multi leg's modelled bytes moved per deliver phase. Blank
+            # for rows without the fused A/B.
+            dms = (row.get("raw") or {}).get("deliver_ms_per_round") or {}
+            dlv_cell = ""
+            if dms.get("per_slot") and dms.get("multi"):
+                dlv_cell = f"{float(dms['per_slot']) / float(dms['multi']):.2f}"
+            dbm = (row.get("raw") or {}).get("deliver_bytes_moved") or {}
+            dmb_cell = f"{float(dbm['multi']) / 1e6:.1f}" \
+                if dbm.get("multi") is not None else ""
             lines.append(
                 f"| {e['source']} | {row['value']} "
                 f"| {row.get('unit', '')} "
                 f"| {hbf_cell} "
                 f"| {spd_cell} "
+                f"| {dlv_cell} "
+                f"| {dmb_cell} "
                 f"| {'yes — ' + reason if _degraded(row) else ''} "
                 f"| {note} |")
         lines.append("")

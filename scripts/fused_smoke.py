@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Fused-deliver smoke: parity + single-launch property + the bench row.
+
+The PR-20 acceptance gate, runnable anywhere the CPU interpreter runs
+(CI has no TPU — pallas interpreter mode executes the SAME launch
+schedule, so everything here except absolute wall-clock is meaningful):
+
+1. **Parity** — a directed-cycle fan-in-1 config at ``mailbox_slots=4``
+   driven for several rounds: ``fused_merge="multi"`` (one pallas launch
+   drains all K slots) must reproduce the unfused XLA gather+blend
+   deliver — params bit-equal for fp32, within dequant tolerance for
+   int8 — with sent/failed accounting bit-equal. The exhaustive dtype /
+   topology / probe-histogram matrix lives in pytest
+   (tests/test_fused_deliver.py); this is the end-to-end canary.
+
+2. **Single-launch HLO property** — ``pallas_launch_count`` over the
+   jaxpr of the round program: unfused traces ZERO pallas calls, fused
+   multi exactly ONE (the whole mailbox in one kernel), compact+fused
+   two (both branches of the live-count cond are traced; each drains in
+   one launch). Counting the traced program makes this a static
+   property, not a profile.
+
+3. **Bench row** — ``bench.bench_fused_regime`` at smoke size (K=4):
+   asserts the row stamps ``raw.deliver_bytes_moved`` (multi strictly
+   below per_slot below/equal plain) and the deliver-phase ms A/B with
+   the multi leg strictly below per_slot (the K->1 launch collapse is a
+   ~2x systematic interpreter-schedule gap, not timing noise). The row
+   lands in ``--out``/fused_row.json (bench_trend ``--row``-ready) and,
+   with ``--ledger``, as a digest row in the shared run ledger.
+
+Exit 0 all gates green, 1 on any violated invariant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+import warnings
+
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+K = 4          # mailbox depth — the multi-slot kernel's design point
+PARITY_N = 12  # directed-cycle nodes (fan-in 1 -> bit-exact fp32 parity)
+PARITY_ROUNDS = 6
+
+
+def _stamp(msg: str) -> None:
+    print(f"[fused_smoke] {msg}", file=sys.stderr)
+
+
+def _parity_sim(fused, history_dtype="float32"):
+    import numpy as np
+    import optax
+
+    from gossipy_tpu.core import (AntiEntropyProtocol, CreateModelMode,
+                                  Topology)
+    from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
+    from gossipy_tpu.handlers import SGDHandler, losses
+    from gossipy_tpu.models import LogisticRegression
+    from gossipy_tpu.simulation import GossipSimulator
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(PARITY_N * 24, 30)).astype(np.float32)
+    y = (X @ rng.normal(size=30) > 0).astype(np.int64)
+    disp = DataDispatcher(ClassificationDataHandler(X, y, test_size=0.2),
+                          n=PARITY_N, eval_on_user=False)
+    handler = SGDHandler(model=LogisticRegression(30, 2),
+                         loss=losses.cross_entropy,
+                         optimizer=optax.sgd(0.1), local_epochs=1,
+                         batch_size=8, n_classes=2, input_shape=(30,),
+                         create_model_mode=CreateModelMode.MERGE_UPDATE)
+    # Directed cycle: every node receives from exactly one peer, so the
+    # unfused slot-iterated blend and the one-launch multi kernel walk
+    # numerically identical reductions (fan-in 1 -> no reassociation).
+    cycle = Topology(np.roll(np.eye(PARITY_N, dtype=bool), 1, axis=1))
+    return GossipSimulator(handler, cycle, disp.stacked(), delta=100,
+                           protocol=AntiEntropyProtocol.PUSH,
+                           fused_merge=fused, mailbox_slots=K,
+                           history_dtype=history_dtype)
+
+
+def _run(sim, rounds=PARITY_ROUNDS):
+    import jax
+    key = jax.random.PRNGKey(0)
+    state = sim.init_nodes(key, common_init=True)
+    state, report = sim.start(state, n_rounds=rounds, key=key,
+                              donate_state=False)
+    jax.block_until_ready(state.model.params)
+    return state, report
+
+
+def check_parity(report: dict) -> list:
+    import jax
+    import numpy as np
+
+    failures = []
+    for dtype, tol in (("float32", 0.0), ("int8", 1e-6)):
+        sims = {leg: _parity_sim(fused, history_dtype=dtype)
+                for leg, fused in (("unfused", False), ("multi", "multi"))}
+        out = {leg: _run(sim) for leg, sim in sims.items()}
+        (s_u, r_u), (s_m, r_m) = out["unfused"], out["multi"]
+        diffs = [float(np.max(np.abs(np.asarray(a, dtype=np.float64)
+                                     - np.asarray(b, dtype=np.float64))))
+                 for a, b in zip(jax.tree.leaves(s_u.model.params),
+                                 jax.tree.leaves(s_m.model.params))]
+        max_diff = max(diffs)
+        sent_eq = (int(r_u.sent_messages) == int(r_m.sent_messages)
+                   and int(r_u.failed_messages) == int(r_m.failed_messages))
+        report.setdefault("parity", {})[dtype] = {
+            "max_abs_diff": max_diff, "tolerance": tol,
+            "sent": int(r_m.sent_messages),
+            "failed": int(r_m.failed_messages),
+            "accounting_bit_equal": sent_eq,
+        }
+        if max_diff > tol:
+            failures.append(f"parity[{dtype}]: fused-multi diverged from "
+                            f"unfused by {max_diff:g} (> {tol:g})")
+        if not sent_eq:
+            failures.append(f"parity[{dtype}]: sent/failed accounting "
+                            "differs between fused and unfused")
+        _stamp(f"parity {dtype}: max|diff| {max_diff:g} (tol {tol:g}), "
+               f"sent {int(r_m.sent_messages)} "
+               f"{'OK' if max_diff <= tol and sent_eq else 'FAIL'}")
+    return failures
+
+
+def check_launch_counts(report: dict) -> list:
+    from gossipy_tpu.analysis.hlo import _make_sim, pallas_launch_count
+
+    failures = []
+    cases = [
+        ("unfused", lambda: _make_sim(), 0),
+        ("fused-multi",
+         lambda: _make_sim(fused_merge=True, mailbox_slots=K), 1),
+        # compact+fused traces BOTH branches of the live-count cond;
+        # each deliver drains the mailbox in one launch.
+        ("fused-compact",
+         lambda: _make_sim(fused_merge=True, compact_deliver=8,
+                           mailbox_slots=K), 2),
+    ]
+    for name, build, want in cases:
+        got = pallas_launch_count(build(), n_rounds=2)
+        report.setdefault("launch", {})[name] = {"want": want, "got": got}
+        if got != want:
+            failures.append(f"launch[{name}]: {got} pallas launches in the "
+                            f"round program, expected {want} — the fused "
+                            "deliver must drain the whole mailbox in one "
+                            "kernel launch")
+        _stamp(f"launch {name}: {got} (want {want}) "
+               f"{'OK' if got == want else 'FAIL'}")
+    return failures
+
+
+def check_bench_row(report: dict, out_dir: str) -> list:
+    import bench
+
+    failures = []
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.bench_fused_regime(rounds=2, n=8)
+    row = None
+    for line in buf.getvalue().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            row = json.loads(line)
+    if row is None:
+        return ["bench: bench_fused_regime emitted no JSON row"]
+    with open(os.path.join(out_dir, "fused_row.json"), "w") as fh:
+        json.dump(row, fh, indent=2)
+        fh.write("\n")
+    raw = row.get("raw") or {}
+    dms = raw.get("deliver_ms_per_round") or {}
+    dbm = raw.get("deliver_bytes_moved") or {}
+    report["bench"] = {"metric": row.get("metric"),
+                       "deliver_ms_per_round": dms,
+                       "deliver_bytes_moved": dbm,
+                       "mailbox_slots": raw.get("mailbox_slots")}
+    if raw.get("mailbox_slots") != K:
+        failures.append(f"bench: row mailbox_slots={raw.get('mailbox_slots')}"
+                        f", expected {K}")
+    if not (dbm.get("multi") and dbm.get("per_slot") and dbm.get("plain")):
+        failures.append("bench: raw.deliver_bytes_moved missing a leg")
+    elif not dbm["multi"] < dbm["per_slot"] <= dbm["plain"]:
+        failures.append(f"bench: bytes-moved model out of order: {dbm}")
+    if dms.get("multi") is None or dms.get("per_slot") is None:
+        failures.append(f"bench: deliver-phase trace missing a leg: {dms}")
+    elif not dms["multi"] < dms["per_slot"]:
+        failures.append(f"bench: multi deliver phase {dms['multi']} ms not "
+                        f"strictly below per_slot {dms['per_slot']} ms — "
+                        "the K->1 launch collapse should be a systematic "
+                        "schedule gap, not noise")
+    _stamp(f"bench: deliver ms {dms}, bytes {dbm.get('multi')}/"
+           f"{dbm.get('per_slot')}/{dbm.get('plain')} "
+           f"{'OK' if not failures else 'FAIL'}")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="fused-artifacts")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="run-ledger file to append the bench row's digest "
+                         "to (shared with the other smokes)")
+    ap.add_argument("--skip-bench", action="store_true",
+                    help="parity + launch counts only (fast lane)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    warnings.filterwarnings(
+        "ignore", message=r"mailbox_slots=\d+ may overflow")
+    os.makedirs(args.out, exist_ok=True)
+
+    import jax
+    t0 = time.time()
+    report: dict = {"backend": jax.default_backend(),
+                    "mailbox_slots": K, "failures": []}
+    _stamp(f"backend {jax.default_backend()}, K={K}")
+
+    failures = []
+    failures += check_parity(report)
+    failures += check_launch_counts(report)
+    if not args.skip_bench:
+        failures += check_bench_row(report, args.out)
+        if args.ledger and "bench" in report:
+            try:
+                from gossipy_tpu.telemetry.ledger import (
+                    ingest_bench_capsule, resolve_ledger)
+                led = resolve_ledger(args.ledger)
+                row_path = os.path.join(args.out, "fused_row.json")
+                if led is not None and os.path.exists(row_path):
+                    ingest_bench_capsule(led, row_path,
+                                         source="fused_smoke")
+                    _stamp(f"ledger: bench row -> {led.path}")
+            except Exception as e:
+                _stamp(f"ledger ingest failed: {e!r}")
+
+    report["failures"] = failures
+    report["elapsed_seconds"] = round(time.time() - t0, 2)
+    with open(os.path.join(args.out, "fused_smoke.json"), "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    for f in failures:
+        _stamp(f"FAIL: {f}")
+    _stamp(f"{'FAILED' if failures else 'PASSED'} in "
+           f"{report['elapsed_seconds']}s")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

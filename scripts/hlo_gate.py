@@ -64,18 +64,33 @@ def main(argv=None) -> int:
         gate_cases,
         hlo_fingerprint,
         lower_text,
+        pallas_launch_count,
     )
 
     t0 = time.time()
     cases = gate_cases()
     report: dict = {"jax": jax.__version__,
                     "backend": jax.default_backend(),
-                    "identity": {}, "fingerprint": {}, "failures": []}
+                    "identity": {}, "fingerprint": {}, "launch": {},
+                    "failures": []}
     failed = False
 
     print(f"[hlo_gate] jax {jax.__version__} backend "
           f"{jax.default_backend()}; {len(cases['identity'])} identity "
-          f"pairs, {len(cases['fingerprint'])} fingerprint cases")
+          f"pairs, {len(cases['fingerprint'])} fingerprint cases, "
+          f"{len(cases.get('launch', []))} launch-count cases")
+
+    for name, build, want in cases.get("launch", []):
+        got = pallas_launch_count(build(), n_rounds=args.n_rounds)
+        report["launch"][name] = {"want": want, "got": got}
+        if got == want:
+            print(f"[hlo_gate] launch-count {name}: {got} OK")
+        else:
+            failed = True
+            report["failures"].append(f"launch:{name}")
+            print(f"[hlo_gate] launch-count {name}: {got} != {want} — the "
+                  "fused deliver must drain the whole mailbox in the "
+                  "declared number of pallas launches")
 
     for name, build_a, build_b in cases["identity"]:
         key = jax.random.PRNGKey(0)

@@ -118,19 +118,33 @@ def test_mfu_flop_decomposition(bench, capfd, monkeypatch):
 
 @pytest.mark.slow
 def test_fused_regime_json_contract(bench, capfd):
-    """--fused-regime off-TPU: plain timing is measured, the fused leg is
-    skipped with an explicit reason in raw.error. (CNN compile is ~30 s on
-    this host: slow lane.)"""
+    """--fused-regime off-TPU: plain timing is measured, the wall-clock
+    fused legs are skipped with an explicit reason in raw.error, and the
+    deliver-phase / bytes-moved columns are stamped for all three legs
+    (plain / per_slot / multi). (CNN compile is ~30 s on this host: slow
+    lane.)"""
     import jax
     bench.bench_fused_regime(rounds=1, n=4)
     row = last_json(capfd)
     assert row["metric"] == "fused_merge_speedup_cnn_clique"
     raw = row["raw"]
     assert np.isfinite(raw["plain_ms_per_round"])
+    assert raw["mailbox_slots"] == 4
+    bytes_moved = raw["deliver_bytes_moved"]
+    assert set(bytes_moved) >= {"plain", "per_slot", "multi",
+                                "wire_bytes_per_message"}
+    # The K->1 HBM collapse must be visible in the model: one pass over
+    # the params matrix instead of K, gather term unchanged.
+    assert bytes_moved["multi"] < bytes_moved["per_slot"] \
+        <= bytes_moved["plain"]
+    assert bytes_moved["wire_bytes_per_message"] > 0
+    assert set(raw["deliver_ms_per_round"]) == {"plain", "per_slot", "multi"}
     if jax.default_backend() != "tpu":
         assert row["value"] is None
         assert raw["fused_ms_per_round"] is None
+        assert raw["per_slot_ms_per_round"] is None
         assert "skipped off-TPU" in raw["error"]
+        assert raw["deliver_timing_mode"] == "cpu_interpreter"
 
 
 @pytest.mark.slow

@@ -155,6 +155,45 @@ class TestCompactRepetitions:
                                        atol=1e-6)
 
 
+class TestCompactFused:
+    """compact_deliver composed with the single-pass fused deliver
+    (fused_merge="multi"): the live-count cond dispatches the SAME
+    multi-slot kernel over the [cap] gathered batch, so the trajectory
+    must be bit-identical to the uncompacted fused run — and the legacy
+    per-slot fused path must refuse to co-enable."""
+
+    def _run(self, compact, key, rounds=6):
+        sim = make_sim(compact, fused_merge="multi")
+        return (*run(sim, key, rounds), sim)
+
+    def test_fused_dispatch_matches_uncompacted(self, key):
+        s_off, r_off, _ = self._run(False, key)
+        s_on, r_on, sim_on = self._run(16, key)
+        assert sim_on._compact_cap == 16
+        for a, b in zip(jax.tree_util.tree_leaves(s_off.model.params),
+                        jax.tree_util.tree_leaves(s_on.model.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert r_off.sent_messages == r_on.sent_messages
+        assert r_off.failed_messages == r_on.failed_messages
+        # cap == population: every round takes the compact branch.
+        assert int(np.asarray(r_on.compact_slots_per_round).sum()) > 0
+        assert int(np.asarray(r_on.wide_slots_per_round).sum()) == 0
+
+    def test_fused_overflow_falls_back(self, key):
+        # cap=2 on 16 nodes overflows most rounds: both cond branches run
+        # across the trajectory, which must still match bit-for-bit.
+        s_off, r_off, _ = self._run(False, key)
+        s_on, r_on, _ = self._run(2, key)
+        for a, b in zip(jax.tree_util.tree_leaves(s_off.model.params),
+                        jax.tree_util.tree_leaves(s_on.model.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(np.asarray(r_on.wide_slots_per_round).sum()) > 0
+
+    def test_per_slot_with_compact_rejected(self, key):
+        with pytest.raises(AssertionError, match="per_slot|per-slot"):
+            make_sim(4, fused_merge="per_slot")
+
+
 class TestCompactSharded:
     def test_sharded_matches_unsharded(self, key):
         # The compacted path's argsort/gather/scatter must compile and run

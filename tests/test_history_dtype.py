@@ -203,10 +203,12 @@ class TestDequantKernel:
     def test_fused_engine_path_matches_unfused(self, key, history_dtype):
         """fused_merge over a quantized ring (kernel dequant) == the
         gather->decode->blend path (same PRNG streams, fp reassociation
-        only)."""
+        only). "per_slot" keeps the slot-interleaved semantics this
+        clique config needs; the multi-slot path's parity matrix is in
+        test_fused_deliver.py."""
         sim_a = make_sim(history_dtype, n_nodes=12, fused_merge=False,
                          compact_deliver=False)
-        sim_b = make_sim(history_dtype, n_nodes=12, fused_merge=True)
+        sim_b = make_sim(history_dtype, n_nodes=12, fused_merge="per_slot")
         _, sa = final_acc(sim_a, key, rounds=6)
         _, sb = final_acc(sim_b, key, rounds=6)
         for la, lb in zip(jax.tree_util.tree_leaves(sa.model.params),

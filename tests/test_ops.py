@@ -10,7 +10,8 @@ from gossipy_tpu.core import AntiEntropyProtocol, CreateModelMode, Topology, Uni
 from gossipy_tpu.data import ClassificationDataHandler, DataDispatcher
 from gossipy_tpu.handlers import LimitedMergeSGDHandler, SGDHandler, losses
 from gossipy_tpu.models import LogisticRegression
-from gossipy_tpu.ops import gather_merge_flat, gather_merge_pytree
+from gossipy_tpu.ops import (gather_merge_flat, gather_merge_multi,
+                             gather_merge_multi_pytree, gather_merge_pytree)
 from gossipy_tpu.ops.merge import gather_merge_reference
 from gossipy_tpu.simulation import GossipSimulator
 
@@ -29,6 +30,72 @@ class TestKernel:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("wire", ["float32", "bfloat16", "int8"])
+    @pytest.mark.parametrize("n,m,f,k", [(16, 48, 116, 4), (8, 8, 512, 2),
+                                         (5, 10, 1, 3)])
+    def test_multi_matches_iterated_flat(self, n, m, f, k, wire):
+        """The K-slot kernel's left-to-right fold must be BIT-identical to
+        iterating the single-slot kernel K times — including zero-weight
+        (empty) slots, which both paths hard-mask."""
+        rng = np.random.default_rng(2)
+        p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+        scale = None
+        if wire == "int8":
+            h = jnp.asarray(rng.integers(-127, 128, (m, f)).astype(np.int8))
+            scale = jnp.asarray(rng.uniform(0.01, 2.0, m).astype(np.float32))
+        else:
+            h = jnp.asarray(rng.normal(size=(m, f)).astype(np.float32)
+                            ).astype(jnp.dtype(wire))
+        idx = jnp.asarray(rng.integers(0, m, (n, k)).astype(np.int32))
+        wp = jnp.asarray(rng.uniform(size=(n, k)).astype(np.float32))
+        # ~1/3 of the slots empty: (w_self, w_peer) = (1, 0).
+        empty = rng.uniform(size=(n, k)) < 0.34
+        wp = jnp.where(jnp.asarray(empty), 0.0, wp)
+        ws = 1.0 - wp
+        got = gather_merge_multi(p, h, idx, ws, wp, scale=scale)
+        want = p
+        for j in range(k):
+            want = gather_merge_flat(want, h, idx[:, j], ws[:, j], wp[:, j],
+                                     scale=scale)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_multi_k1_matches_flat(self):
+        rng = np.random.default_rng(3)
+        n, m, f = 12, 24, 70
+        p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+        h = jnp.asarray(rng.normal(size=(m, f)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+        wp = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+        got = gather_merge_multi(p, h, idx[:, None], (1 - wp)[:, None],
+                                 wp[:, None])
+        want = gather_merge_flat(p, h, idx, 1 - wp, wp)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_multi_empty_slot_inert_to_nonfinite_rows(self):
+        """A garbage (NaN/inf) ring row behind an empty slot's clipped
+        index must not leak: zero-weight slots are where-masked, not
+        multiplied."""
+        rng = np.random.default_rng(4)
+        n, m, f, k = 6, 12, 40, 3
+        p = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+        h = np.asarray(rng.normal(size=(m, f)).astype(np.float32))
+        h[0] = np.nan
+        h[1] = np.inf
+        h = jnp.asarray(h)
+        # Slot 0 live pointing at clean rows; slots 1..k empty pointing at
+        # the poisoned rows.
+        idx = np.full((n, k), 0, np.int32)
+        idx[:, 0] = rng.integers(2, m, n)
+        wp = np.zeros((n, k), np.float32)
+        wp[:, 0] = 0.5
+        out = gather_merge_multi(p, h, jnp.asarray(idx),
+                                 jnp.asarray(1 - wp), jnp.asarray(wp))
+        assert np.isfinite(np.asarray(out)).all()
+        want = gather_merge_flat(p, h, jnp.asarray(idx[:, 0]),
+                                 jnp.asarray(1 - wp[:, 0]),
+                                 jnp.asarray(wp[:, 0]))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
     def test_pytree_form(self):
         rng = np.random.default_rng(1)
         n, d_hist = 6, 3
@@ -45,6 +112,49 @@ class TestKernel:
             want = 0.5 * params[k] + 0.5 * hflat[flat_idx]
             np.testing.assert_allclose(np.asarray(out[k]), np.asarray(want),
                                        rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("wire", ["float32", "int8"])
+    def test_multi_pytree_single_launch_matches_leafwise(self, wire):
+        """The concat single-launch pytree form (all leaves in one [N,
+        sum(F)] matrix; int8 routes per-leaf scales through the
+        block->leaf map) must be BIT-identical to iterating the
+        single-slot kernel per leaf per slot."""
+        rng = np.random.default_rng(5)
+        n, d_hist, k = 8, 3, 4
+        shapes = {"w": (7, 11), "b": (5,)}
+        params = {kk: jnp.asarray(rng.normal(size=(n,) + s)
+                                  .astype(np.float32))
+                  for kk, s in shapes.items()}
+        scales = None
+        if wire == "int8":
+            hist = {kk: jnp.asarray(rng.integers(
+                -127, 128, (d_hist, n) + s).astype(np.int8))
+                for kk, s in shapes.items()}
+            scales = {kk: jnp.asarray(rng.uniform(
+                0.01, 2.0, (d_hist, n)).astype(np.float32))
+                for kk in shapes}
+        else:
+            hist = {kk: jnp.asarray(rng.normal(
+                size=(d_hist, n) + s).astype(np.float32))
+                for kk, s in shapes.items()}
+        flat_idx = jnp.asarray(rng.integers(0, d_hist * n, (n, k))
+                               .astype(np.int32))
+        wp = jnp.asarray(rng.uniform(size=(n, k)).astype(np.float32))
+        wp = jnp.where(jnp.asarray(rng.uniform(size=(n, k)) < 0.3), 0.0, wp)
+        ws = 1.0 - wp
+        out = gather_merge_multi_pytree(params, hist, flat_idx, ws, wp,
+                                        scales=scales)
+        for kk, s in shapes.items():
+            f = int(np.prod(s))
+            want = params[kk].reshape(n, f)
+            hflat = hist[kk].reshape(d_hist * n, f)
+            sflat = (None if scales is None
+                     else scales[kk].reshape(d_hist * n))
+            for j in range(k):
+                want = gather_merge_flat(want, hflat, flat_idx[:, j],
+                                         ws[:, j], wp[:, j], scale=sflat)
+            np.testing.assert_array_equal(
+                np.asarray(out[kk]).reshape(n, f), np.asarray(want))
 
 
 def make_sim(fused, key, d=8, n_nodes=12, **kw):
@@ -64,10 +174,15 @@ def make_sim(fused, key, d=8, n_nodes=12, **kw):
 
 class TestEngineFusedPath:
     def test_fused_equals_unfused(self, key):
-        """The fused pallas deliver path must reproduce the gather+blend path
-        (same PRNG streams; fp reassociation only)."""
+        """The per-slot fused pallas deliver must reproduce the gather+blend
+        path (same PRNG streams; fp reassociation only). Pinned to
+        "per_slot": on a clique fan-in exceeds 1, where the default
+        "multi" path applies the documented compound-merge semantics
+        (merge all slots, train once) instead of interleaving — its
+        parity contract lives in test_fused_deliver.py on fan-in-1
+        topologies."""
         sim_a = make_sim(False, key)
-        sim_b = make_sim(True, key)
+        sim_b = make_sim("per_slot", key)
         st_a = sim_a.init_nodes(key)
         st_b = sim_b.init_nodes(key)
         fa, ra = sim_a.start(st_a, n_rounds=6, key=key)
